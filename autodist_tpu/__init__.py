@@ -1,0 +1,35 @@
+"""autodist_tpu — a TPU-native distributed training framework.
+
+A strategy-compiled engine in the spirit of AutoDist (reference:
+``autodist/autodist.py``, ``docs/design/architecture.rst:27-39``): the user writes
+single-device model code; a per-variable distribution **Strategy** (PS, load-balanced PS,
+partitioned PS, AllReduce, partitioned/random-axis AllReduce, Parallax hybrid) is built
+from the model plus a YAML **resource spec**, and materialized by a backend. Here the
+backend is idiomatic JAX/XLA: ``pjit``/``shard_map`` shardings and
+``psum``/``reduce_scatter``/``all_gather`` collectives over a TPU mesh (ICI/DCN), instead
+of TensorFlow graph rewriting over grpc/NCCL.
+
+Layer map (mirrors reference SURVEY.md §1, re-targeted):
+
+- User API:        :mod:`autodist_tpu.autodist`  (``AutoDist(...).scope()`` / ``function()``)
+- Strategy:        :mod:`autodist_tpu.strategy`  (8 builders -> Strategy proto -> compiler)
+- IR:              :mod:`autodist_tpu.model_spec` (param-pytree metadata; replaces GraphItem)
+- Kernel backend:  :mod:`autodist_tpu.parallel`  (sharding compiler, synchronizers, mesh)
+- Runtime:         :mod:`autodist_tpu.runner`    (DistributedRunner; replaces WrappedSession)
+- Cluster:         :mod:`autodist_tpu.cluster`, :mod:`autodist_tpu.coordinator`
+- Checkpoint:      :mod:`autodist_tpu.checkpoint`
+"""
+
+from autodist_tpu.version import __version__
+
+__all__ = ["AutoDist", "get_default_autodist", "ResourceSpec", "__version__"]
+
+
+def __getattr__(name):  # PEP 562 lazy imports to keep `import autodist_tpu` light
+    if name in ("AutoDist", "get_default_autodist"):
+        from autodist_tpu import autodist
+        return getattr(autodist, name)
+    if name == "ResourceSpec":
+        from autodist_tpu.resource_spec import ResourceSpec
+        return ResourceSpec
+    raise AttributeError(f"module 'autodist_tpu' has no attribute {name!r}")

@@ -1,0 +1,81 @@
+"""Constants and environment-variable configuration.
+
+Capability parity with the reference's ``autodist/const.py``: working directories under
+``/tmp/autodist_tpu``, a typed env-var enum with per-var defaults (reference
+``const.py:55-89``), and the chief/worker role-split variables that the coordinator
+propagates to remote hosts (reference ``coordinator.py:66-90``).
+"""
+
+import enum
+import os
+
+# Working directories (reference const.py:30-38 uses /tmp/autodist).
+DEFAULT_WORKING_DIR = os.environ.get("AUTODIST_WORKING_DIR", "/tmp/autodist_tpu")
+DEFAULT_SERIALIZATION_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
+DEFAULT_GRAPH_DUMP_DIR = os.path.join(DEFAULT_WORKING_DIR, "graphs")
+DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+
+# Port range for the coordination service (reference const.py:38 used 15000-16000 for
+# tf.Server; here it is the jax.distributed coordinator port range).
+DEFAULT_PORT_RANGE = iter(range(15000, 16000))
+DEFAULT_COORDINATOR_PORT = 15000
+
+# Mesh axis names. The reference reified data-parallel replicas as a device list
+# (strategy.proto:62-68); the TPU build reifies them as named mesh axes.
+MESH_AXIS_DATA = "data"          # data parallelism (batch dim)
+MESH_AXIS_REDUCE = "reduce"      # weight-update/PS sharding axis (ZeRO-style)
+MESH_AXIS_MODEL = "model"        # tensor/variable partitioning axis
+MESH_AXIS_SEQ = "seq"            # sequence/context parallelism axis
+MESH_AXIS_EXPERT = "expert"      # expert parallelism axis
+MESH_AXIS_PIPE = "pipe"          # pipeline parallelism axis
+
+MAX_INT32 = 2**31 - 1
+MAX_INT64 = 2**63 - 1
+
+
+class ENV(enum.Enum):
+    """Typed environment variables with defaults (reference const.py:55-89).
+
+    Each member's value is a lambda evaluating the default; ``.val`` reads the
+    environment with fallback.
+    """
+
+    # Values are 1-tuples holding the default (a bare callable would become an enum
+    # method rather than a member).
+    AUTODIST_WORKER = ("",)                    # non-empty => this process is a worker
+    AUTODIST_STRATEGY_ID = ("",)               # strategy id shipped by the chief
+    AUTODIST_MIN_LOG_LEVEL = ("INFO",)
+    AUTODIST_IS_TESTING = (False,)             # extra invariants under test
+    AUTODIST_DEBUG_REMOTE = (False,)           # verbose remote launch logging
+    AUTODIST_INTERNAL_TF = (False,)            # kept for API parity (no-op on TPU)
+    AUTODIST_PATCH_TF = (False,)               # kept for API parity (no-op on TPU)
+    SYS_DATA_PATH = ("",)
+    SYS_RESOURCE_PATH = ("",)
+    # TPU-native additions: multi-host bootstrap (replaces tf.Server cluster membership).
+    AUTODIST_COORDINATOR_ADDR = ("",)          # "ip:port" of jax.distributed coordinator
+    AUTODIST_NUM_PROCESSES = (1,)
+    AUTODIST_PROCESS_ID = (0,)
+
+    @property
+    def val(self):
+        """Return the env value, parsed to the default's type when set."""
+        raw = os.environ.get(self.name)
+        default = self.value[0]
+        if raw is None:
+            return default
+        if isinstance(default, bool):
+            return raw.strip().lower() not in ("", "0", "false", "no", "off")
+        if isinstance(default, int):
+            return int(raw)
+        return raw
+
+
+def is_worker() -> bool:
+    """True when this process was launched by the coordinator as a worker replica."""
+    return bool(ENV.AUTODIST_WORKER.val)
+
+
+def is_chief_process() -> bool:
+    return not is_worker()

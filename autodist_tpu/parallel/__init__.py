@@ -1,0 +1,11 @@
+"""Kernel backend: mesh bootstrap, sharding compiler, synchronizers, partitioners.
+
+This package is the TPU-native counterpart of the reference's graph-rewriting kernel
+backend (``autodist/kernel/*``): instead of mutating a ``tf.Graph``, it compiles a
+Strategy into per-parameter ``PartitionSpec``s plus a gradient-synchronization transform
+applied around the user's step function under ``jax.jit`` over a ``jax.sharding.Mesh``.
+"""
+
+from autodist_tpu.parallel.mesh import build_mesh, standard_mesh_shape, STANDARD_AXES
+
+__all__ = ["build_mesh", "standard_mesh_shape", "STANDARD_AXES"]

@@ -1,0 +1,38 @@
+"""DeviceSpec string round-trip — parity with reference tests/test_device_spec.py:11-20."""
+
+from autodist_tpu.resource_spec import Connectivity, DeviceSpec, DeviceType
+
+
+def test_tpu_device_string_roundtrip():
+    d = DeviceSpec("10.0.0.1", DeviceType.TPU, 3)
+    assert d.name_string == "10.0.0.1:TPU:3"
+    d2 = DeviceSpec.from_string(d.name_string)
+    assert d2 == d
+    assert d2.device_type is DeviceType.TPU
+    assert d2.device_index == 3
+
+
+def test_cpu_device_string_is_bare_host():
+    d = DeviceSpec("localhost")
+    assert d.name_string == "localhost"
+    assert DeviceSpec.from_string("localhost") == d
+
+
+def test_gpu_device_string_accepted_for_compat():
+    d = DeviceSpec.from_string("1.2.3.4:GPU:0")
+    assert d.device_type is DeviceType.GPU
+
+
+def test_malformed_device_string_raises():
+    import pytest
+    with pytest.raises(ValueError):
+        DeviceSpec.from_string("a:b:c:d")
+
+
+def test_connectivity():
+    a = DeviceSpec("h1", DeviceType.TPU, 0)
+    b = DeviceSpec("h1", DeviceType.TPU, 1)
+    c = DeviceSpec("h2", DeviceType.TPU, 0)
+    assert a.connectivity_with(b) is Connectivity.SAME_HOST
+    assert a.connectivity_with(c) is Connectivity.ETHERNET
+    assert a.connectivity_with(a) is Connectivity.SAME_DEVICE

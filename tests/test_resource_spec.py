@@ -1,0 +1,88 @@
+"""ResourceSpec YAML parsing — parity with reference tests/test_resource_spec.py."""
+
+import pytest
+
+from autodist_tpu.resource_spec import (DEFAULT_NETWORK_BANDWIDTH_GBPS, DeviceType,
+                                        ResourceSpec)
+
+TWO_NODE_YAML = """
+nodes:
+  - address: 10.0.0.1
+    tpus: 4
+    chief: true
+    ssh_config: conf
+    network_bandwidth: 100
+  - address: 10.0.0.2
+    tpus: 4
+    ssh_config: conf
+ssh:
+  conf:
+    username: me
+    key_file: /tmp/id_rsa
+    port: 2222
+    python_venv: source /env/bin/activate
+    shared_envs:
+      LD_LIBRARY_PATH: /usr/lib
+"""
+
+
+def test_two_node_parse(tmp_path):
+    p = tmp_path / "spec.yml"
+    p.write_text(TWO_NODE_YAML)
+    spec = ResourceSpec(str(p))
+    assert spec.num_nodes == 2
+    assert spec.chief_address == "10.0.0.1"
+    assert spec.num_accelerators == 8
+    assert [d for _, d in spec.tpu_devices][0].device_type is DeviceType.TPU
+    # bandwidth default (reference resource_spec.py:209-215)
+    assert spec.node_bandwidth("10.0.0.2") == DEFAULT_NETWORK_BANDWIDTH_GBPS
+    assert spec.node_bandwidth("10.0.0.1") == 100
+    ssh = spec.ssh_config_for("10.0.0.2")
+    assert ssh.username == "me" and ssh.port == 2222
+    assert ssh.shared_envs["LD_LIBRARY_PATH"] == "/usr/lib"
+
+
+def test_inline_yaml_string():
+    spec = ResourceSpec("nodes: [{address: localhost, tpus: 2}]")
+    assert spec.num_nodes == 1
+    # single node becomes chief implicitly
+    assert spec.chief_address == "localhost"
+
+
+def test_sorted_nodes_chief_first_then_lexicographic():
+    spec = ResourceSpec("nodes: [{address: b, tpus: 1}, {address: c, tpus: 1, chief: true}, {address: a, tpus: 1}]")
+    assert [n.address for n in spec.sorted_nodes] == ["c", "a", "b"]
+
+
+def test_two_chiefs_rejected():
+    with pytest.raises(ValueError, match="chief"):
+        ResourceSpec("nodes: [{address: a, chief: true}, {address: b, chief: true}]")
+
+
+def test_multi_node_without_chief_rejected():
+    with pytest.raises(ValueError, match="chief"):
+        ResourceSpec("nodes: [{address: a}, {address: b}]")
+
+
+def test_duplicate_addresses_rejected():
+    with pytest.raises(ValueError, match="Duplicate"):
+        ResourceSpec("nodes: [{address: a, chief: true}, {address: a}]")
+
+
+def test_cpu_only_node_contributes_cpu_replica():
+    spec = ResourceSpec("nodes: [{address: a, tpus: 2, chief: true}, {address: b}]")
+    reps = spec.replica_devices
+    # reference ps_strategy.py:37-56: GPU-less (here TPU-less) nodes replicate on CPU
+    assert len(reps) == 3
+    assert reps[-1].device_type is DeviceType.CPU
+
+
+def test_local_default_spec_matches_visible_devices():
+    import jax
+    spec = ResourceSpec()
+    assert spec.num_accelerators == len(jax.devices())
+
+
+def test_mesh_section_parsed():
+    spec = ResourceSpec("{nodes: [{address: a, tpus: 8}], mesh: {data: 2, model: 4}}")
+    assert spec.mesh_config == {"data": 2, "model": 4}
