@@ -1,0 +1,225 @@
+"""ModelSpec — the framework IR. Replaces the reference's GraphItem.
+
+The reference captured a ``tf.Graph`` plus grad↔var pairs via optimizer monkey patches
+(``autodist/graph_item.py:73-109,301-317``). In JAX there is no global graph to
+capture: the IR is simply *metadata about the parameter pytree* of a user-supplied
+train step — name, shape, dtype, and whether the gradient is sparse (embedding-style).
+Everything the reference extracted by graph scanning (update-op discovery via op-type
+tables, ``graph_item.py:345-419``; IndexedSlices detection, ``:301-317``) falls out of
+the functional signature, with sparse-gradient detection done by jaxpr analysis instead
+of IndexedSlices typing.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _path_name(path) -> str:
+    """Render a jax tree path as a stable '/'-joined name.
+
+    These names play the role of the reference's variable names: they key strategy
+    NodeConfigs and name checkpoint entries (reference saved under original
+    single-node names, ``checkpoint/saver.py:47-61``).
+    """
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return "/".join(parts) or "param"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Metadata for one trainable parameter (reference: one strategy Node's subject)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: Any
+    sparse: bool = False        # gradient is row-sparse (reference IndexedSlices)
+    trainable: bool = True
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def byte_size(self) -> int:
+        return self.size * np.dtype(self.dtype).itemsize
+
+
+class ModelSpec:
+    """Parameter-pytree metadata + the original tree structure for round-tripping."""
+
+    def __init__(self, params: PyTree, sparse_names: Sequence[str] = (),
+                 trainable_filter: Optional[Callable[[str], bool]] = None):
+        leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+        self.treedef = treedef
+        self._names: List[str] = []
+        self.params: Dict[str, ParamSpec] = {}
+        sparse_names = set(sparse_names)
+        for path, leaf in leaves_with_paths:
+            name = _path_name(path)
+            if name in self.params:
+                raise ValueError(
+                    f"Parameter name collision: two leaves render as {name!r} "
+                    f"(names key strategy configs and checkpoints, so they must be unique)")
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            dtype = getattr(leaf, "dtype", np.float32)
+            trainable = trainable_filter(name) if trainable_filter else True
+            self._names.append(name)
+            self.params[name] = ParamSpec(
+                name=name, shape=shape, dtype=dtype,
+                sparse=name in sparse_names, trainable=trainable)
+
+    # --- constructors ---
+
+    @classmethod
+    def from_params(cls, params: PyTree, **kwargs) -> "ModelSpec":
+        return cls(params, **kwargs)
+
+    @classmethod
+    def from_init_fn(cls, init_fn: Callable[..., PyTree], *args, **kwargs) -> "ModelSpec":
+        """Build from an initializer without materializing parameters (eval_shape)."""
+        shapes = jax.eval_shape(init_fn, *args, **kwargs)
+        return cls(shapes)
+
+    @classmethod
+    def from_loss_fn(cls, loss_fn: Callable, params: PyTree, *example_args) -> "ModelSpec":
+        """Build with automatic sparse-gradient detection.
+
+        The reference learned a gradient was sparse when TF produced ``IndexedSlices``
+        (``graph_item.py:301-317``). Here we inspect the jaxpr of ``loss_fn``: a
+        parameter consumed **only** by gather/embedding-lookup ops receives row-sparse
+        updates, so its PS placement should use the sparse path (Parallax semantics,
+        reference ``parallax_strategy.py:38-71``).
+        """
+        spec = cls(params)
+        sparse = set(detect_sparse_params(loss_fn, params, *example_args))
+        for name in sparse:
+            if name in spec.params:
+                spec.params[name] = dataclasses.replace(spec.params[name], sparse=True)
+        return spec
+
+    # --- accessors ---
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._names)
+
+    @property
+    def trainable(self) -> Dict[str, ParamSpec]:
+        return {n: p for n, p in self.params.items() if p.trainable}
+
+    def __getitem__(self, name: str) -> ParamSpec:
+        return self.params[name]
+
+    def name_to_leaf_index(self) -> Dict[str, int]:
+        return {n: i for i, n in enumerate(self._names)}
+
+    def unflatten(self, leaves: Sequence[Any]) -> PyTree:
+        return jax.tree_util.tree_unflatten(self.treedef, list(leaves))
+
+    def flatten(self, tree: PyTree) -> List[Any]:
+        return jax.tree_util.tree_leaves(tree)
+
+    def __repr__(self):
+        return f"ModelSpec({len(self.params)} params, {sum(p.byte_size for p in self.params.values())} bytes)"
+
+
+# --- sparse-gradient detection by jaxpr analysis ---
+
+_GATHER_PRIMS = {"gather", "take", "dynamic_slice"}
+
+
+def detect_sparse_params(loss_fn: Callable, params: PyTree, *example_args) -> List[str]:
+    """Names of parameters whose only use in ``loss_fn`` is a gather (embedding lookup).
+
+    Best-effort static analysis: traces the forward jaxpr once and tracks, for each
+    parameter input var, the primitives that consume it. Parameters consumed solely by
+    ``gather``-family primitives get row-sparse gradients (a scatter-add), which the
+    PS/Parallax strategies route to the sparse path.
+    """
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+    names = [_path_name(p) for p, _ in leaves_with_paths]
+    leaves = [l for _, l in leaves_with_paths]
+
+    def flat_loss(*flat_params_and_args):
+        flat_params = flat_params_and_args[:len(leaves)]
+        args = flat_params_and_args[len(leaves):]
+        tree = jax.tree_util.tree_unflatten(treedef, list(flat_params))
+        return loss_fn(tree, *args)
+
+    try:
+        jaxpr = jax.make_jaxpr(flat_loss)(*leaves, *example_args).jaxpr
+    except Exception:  # tracing failed (e.g. non-jittable loss) — no detection
+        return []
+
+    param_vars = {v: names[i] for i, v in enumerate(jaxpr.invars[:len(leaves)])}
+    consumers: Dict[Any, set] = {v: set() for v in param_vars}
+    _collect_consumers(jaxpr, consumers)
+
+    out = []
+    for v, name in param_vars.items():
+        prims = consumers.get(v, set())
+        if prims and prims <= _GATHER_PRIMS:
+            out.append(name)
+    return out
+
+
+def _is_var(x) -> bool:
+    # jaxpr invars may be Literal (unhashable); only track proper Vars.
+    return type(x).__name__ == "Var"
+
+
+# Wrapper primitives whose body we look through: consuming a param via one of these is
+# not itself a "use"; the uses are inside the sub-jaxpr (jnp.take lowers to a pjit-of-
+# gather, custom_jvp wraps most nn functions).
+_TRANSPARENT_PRIMS = {"pjit", "jit", "closed_call", "core_call", "xla_call",
+                      "custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr",
+                      "remat", "checkpoint", "remat2", "custom_vjp_call_jaxpr"}
+
+
+def _sub_jaxpr(eqn):
+    for param in eqn.params.values():
+        inner = getattr(param, "jaxpr", None)
+        if inner is not None:
+            return inner.jaxpr if hasattr(inner, "jaxpr") else inner
+        if type(param).__name__ == "Jaxpr":
+            return param
+    return None
+
+
+def _collect_consumers(jaxpr, consumers):
+    for eqn in jaxpr.eqns:
+        transparent = eqn.primitive.name in _TRANSPARENT_PRIMS
+        inner = _sub_jaxpr(eqn) if transparent else None
+        if inner is not None:
+            # Map outer invars to inner invars positionally (holds for pjit/call-style
+            # primitives) and recurse so a gather inside jnp.take's wrapper is seen.
+            inner_invars = list(getattr(inner, "invars", []))
+            offset = len(inner_invars) - len(eqn.invars)  # leading consts, if any
+            for i, outer in enumerate(eqn.invars):
+                if not (_is_var(outer) and outer in consumers):
+                    continue
+                j = i + max(offset, 0)
+                if j < len(inner_invars):
+                    tmp = {inner_invars[j]: set()}
+                    _collect_consumers(inner, tmp)
+                    consumers[outer] |= tmp[inner_invars[j]]
+            continue
+        for invar in eqn.invars:
+            if _is_var(invar) and invar in consumers:
+                consumers[invar].add(eqn.primitive.name)

@@ -1,0 +1,8 @@
+"""Generated protobuf modules.
+
+Regenerate after editing ``strategy.proto``::
+
+    protoc --python_out=. autodist_tpu/proto/strategy.proto
+
+(run from the repo root; generated ``*_pb2.py`` files are checked in).
+"""

@@ -1,0 +1,25 @@
+"""Strategy builders — the "compiler frontend".
+
+Eight builders with the same distribution policies as the reference
+(``autodist/strategy/*``), operating on (ModelSpec, ResourceSpec) and emitting a
+serializable Strategy proto. The policies are pure placement/synchronization
+algorithms and port at the algorithm level; what changes is the target: node configs
+compile into mesh shardings instead of TF device strings.
+"""
+
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder, StrategyCompiler
+from autodist_tpu.strategy.ps_strategy import PS
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing, byte_size_load_fn
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import RandomAxisPartitionAR
+from autodist_tpu.strategy.parallax_strategy import Parallax
+
+__all__ = [
+    "Strategy", "StrategyBuilder", "StrategyCompiler",
+    "PS", "PSLoadBalancing", "byte_size_load_fn", "PartitionedPS",
+    "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
+    "RandomAxisPartitionAR", "Parallax",
+]

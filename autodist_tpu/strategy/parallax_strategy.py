@@ -1,0 +1,52 @@
+"""Parallax hybrid strategy: dense gradients AllReduce, sparse gradients PS.
+
+Port of reference ``autodist/strategy/parallax_strategy.py:24-71`` (after the Parallax
+paper): dense parameters use gradient all-reduce; embedding-style parameters with
+row-sparse gradients use load-balanced PS placement, which on TPU compiles to sharded
+embedding storage with row-local updates.
+"""
+
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import parse_ar_options
+from autodist_tpu.strategy.base import AR_DEFAULT_AXES, Strategy
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+
+
+class Parallax(PSLoadBalancing):
+    # Data parallelism stays primary in the hybrid; PS destinations are computed
+    # against this same axis default, so they always fit the recorded mesh.
+    _default_axes = AR_DEFAULT_AXES
+
+    def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
+                 compressor: str = "NoneCompressor", local_proxy_variable: bool = False,
+                 sync: bool = True, staleness: int = 0):
+        super().__init__(local_proxy_variable=local_proxy_variable, sync=sync,
+                         staleness=staleness)
+        self._chunk_size, self._spec, self._compressor = parse_ar_options(
+            chunk_size, all_reduce_spec, compressor)
+
+    def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
+        strategy = Strategy()
+        n_dest = self._num_destinations(resource_spec)
+        loads = [0] * n_dest
+        dense_idx = 0
+        for spec in model_spec.trainable.values():
+            node = strategy.proto.node_config.add(var_name=spec.name)
+            node.sparse = spec.sparse
+            if spec.sparse:
+                dest = min(range(n_dest), key=loads.__getitem__)
+                loads[dest] += self._load_fn(spec)
+                node.ps_synchronizer.reduction_destination = f"reduce:{dest}"
+                node.ps_synchronizer.local_replication = self._local_proxy_variable
+                node.ps_synchronizer.sync = self._sync
+                node.ps_synchronizer.staleness = self._staleness
+            else:
+                ar = node.all_reduce_synchronizer
+                ar.spec = self._spec
+                ar.compressor = self._compressor
+                ar.group = dense_idx // self._chunk_size
+                dense_idx += 1
+        self._fill_mesh_config(strategy, resource_spec,
+                               self._resolved_axes(resource_spec, self._default_axes))
+        return strategy

@@ -1,0 +1,60 @@
+"""Partitioned PS strategy — shard large parameters, then place shards round-robin.
+
+Port of reference ``autodist/strategy/partitioned_ps_strategy.py``: per-variable shard
+count = smallest divisor >= 2 of dim0 (``:125-135``), shards placed greedily
+round-robin by load (``:88-95``), emitted as ``partitioner`` + ``part_config``
+children (``:106-122``). Parameters that cannot be partitioned (scalars, dim0 < 2)
+fall back to plain load-balanced PS. On TPU the shards additionally map the parameter
+itself onto the ``model`` mesh axis when it has size > 1 (tensor-sharded storage).
+"""
+
+from autodist_tpu import const
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.strategy.partition_utils import (make_num_shards, partitionable_axis,
+                                                   smallest_divisor_at_least_2)
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+
+
+class PartitionedPS(PSLoadBalancing):
+    """PS with per-parameter variable partitioning (reference PartitionedPS)."""
+
+    # Shard-count policy; the uneven variant overrides this single hook.
+    @staticmethod
+    def _shard_count(dim0: int, cap: int):
+        return smallest_divisor_at_least_2(dim0, cap)
+
+    def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
+        strategy = Strategy()
+        n_dest = self._num_destinations(resource_spec)
+        loads = [0] * n_dest
+        for spec in model_spec.trainable.values():
+            node = strategy.proto.node_config.add(var_name=spec.name)
+            node.sparse = spec.sparse
+            axis = partitionable_axis(spec)
+            k = self._shard_count(spec.shape[axis], n_dest * 4) if axis is not None else None
+            if k is None or k < 2:
+                dest = min(range(n_dest), key=loads.__getitem__)
+                loads[dest] += self._load_fn(spec)
+                self._fill_ps(node, dest)
+                continue
+            node.partitioner.num_shards.extend(make_num_shards(len(spec.shape), axis, k))
+            node.partitioner.mesh_axis = const.MESH_AXIS_MODEL
+            shard_load = max(self._load_fn(spec) // k, 1)
+            for i in range(k):
+                # Round-robin greedy placement of shards (reference :88-95).
+                dest = min(range(n_dest), key=loads.__getitem__)
+                loads[dest] += shard_load
+                part = node.part_config.add(var_name=f"{spec.name}/part_{i}")
+                part.sparse = spec.sparse
+                self._fill_ps(part, dest)
+        self._fill_mesh_config(strategy, resource_spec,
+                               self._resolved_axes(resource_spec, self._default_axes))
+        return strategy
+
+    def _fill_ps(self, node, dest: int):
+        node.ps_synchronizer.reduction_destination = f"reduce:{dest}"
+        node.ps_synchronizer.local_replication = self._local_proxy_variable
+        node.ps_synchronizer.sync = self._sync
+        node.ps_synchronizer.staleness = self._staleness

@@ -1,0 +1,37 @@
+"""PS strategy: every parameter synchronized parameter-server style.
+
+Reference ``autodist/strategy/ps_strategy.py:37-56`` placed all variables on the first
+CPU device and replicated computation on all GPUs. The TPU compilation of "PS" is
+weight-update sharding: gradients reduce-scatter onto the parameter's home shard along
+the ``reduce`` mesh axis, the optimizer update runs there, and parameters all-gather
+back. A single logical destination (``reduce:0``) is recorded for protocol parity.
+"""
+
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import PS_DEFAULT_AXES, Strategy, StrategyBuilder
+
+
+class PS(StrategyBuilder):
+    """All parameters -> one PS destination (reference ps_strategy.py)."""
+
+    def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
+                 staleness: int = 0):
+        if staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        self._local_proxy_variable = local_proxy_variable
+        self._sync = sync
+        self._staleness = staleness
+
+    def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
+        strategy = Strategy()
+        for name in model_spec.trainable:
+            node = strategy.proto.node_config.add(var_name=name)
+            node.ps_synchronizer.reduction_destination = "reduce:0"
+            node.ps_synchronizer.local_replication = self._local_proxy_variable
+            node.ps_synchronizer.sync = self._sync
+            node.ps_synchronizer.staleness = self._staleness
+            node.sparse = model_spec[name].sparse
+        self._fill_mesh_config(strategy, resource_spec,
+                               self._resolved_axes(resource_spec, PS_DEFAULT_AXES))
+        return strategy

@@ -1,0 +1,28 @@
+"""Random-axis partitioned AllReduce strategy.
+
+Port of reference ``random_axis_partition_all_reduce_strategy.py:118-141``: like
+PartitionedAR, but dense parameters partition a randomly chosen axis with size >= 2
+(sparse parameters are forced to axis 0 so row updates stay shard-local). Seeded for
+reproducibility across chief and workers.
+"""
+
+import random
+
+from autodist_tpu.strategy.partition_utils import smallest_divisor_at_least_2
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+
+
+class RandomAxisPartitionAR(PartitionedAR):
+    def __init__(self, chunk_size: int = 128, seed: int = 0, **kwargs):
+        super().__init__(chunk_size=chunk_size, **kwargs)
+        self._rng = random.Random(seed)
+
+    def _choose_axis_and_count(self, spec, seed_idx: int):
+        if spec.sparse:
+            axis = 0 if spec.shape and spec.shape[0] >= 2 else None
+        else:
+            candidates = [i for i, d in enumerate(spec.shape) if d >= 2]
+            axis = self._rng.choice(candidates) if candidates else None
+        if axis is None:
+            return None, None
+        return axis, smallest_divisor_at_least_2(spec.shape[axis])

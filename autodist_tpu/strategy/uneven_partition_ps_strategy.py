@@ -1,0 +1,16 @@
+"""Uneven-partition PS strategy.
+
+Port of reference ``autodist/strategy/uneven_partition_ps_strategy.py``: identical to
+PartitionedPS except the shard count is the smallest **non**-divisor >= 2 of dim0
+(``:125-135``), deliberately producing uneven shards to exercise remainder logic. On
+TPU uneven shards compile to pad-and-mask sharding (SURVEY.md §7.3 hard part #2).
+"""
+
+from autodist_tpu.strategy.partition_utils import smallest_non_divisor_at_least_2
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+
+
+class UnevenPartitionedPS(PartitionedPS):
+    @staticmethod
+    def _shard_count(dim0: int, cap: int):
+        return smallest_non_divisor_at_least_2(dim0, cap)

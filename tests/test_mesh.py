@@ -63,7 +63,7 @@ def test_psum_on_mesh_works():
     def total(v):
         return jax.lax.psum(v, const.MESH_AXIS_DATA)
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     f = shard_map(total, mesh=mesh,
                   in_specs=P(const.MESH_AXIS_DATA),
                   out_specs=P())

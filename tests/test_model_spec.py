@@ -1,0 +1,76 @@
+"""ModelSpec IR — parity with reference tests/test_graph_item.py (capture tables)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.model_spec import ModelSpec, detect_sparse_params
+
+
+def _params():
+    return {
+        "dense": {"w": jnp.zeros((4, 3)), "b": jnp.zeros((3,))},
+        "emb": {"table": jnp.zeros((100, 8))},
+    }
+
+
+def test_names_shapes_dtypes():
+    spec = ModelSpec(_params())
+    assert set(spec.params) == {"dense/w", "dense/b", "emb/table"}
+    assert spec["dense/w"].shape == (4, 3)
+    assert spec["emb/table"].byte_size == 100 * 8 * 4
+    assert spec["dense/b"].size == 3
+
+
+def test_unflatten_roundtrip():
+    params = _params()
+    spec = ModelSpec(params)
+    leaves = spec.flatten(params)
+    tree = spec.unflatten(leaves)
+    assert jax.tree_util.tree_structure(tree) == jax.tree_util.tree_structure(params)
+
+
+def test_from_init_fn_uses_eval_shape():
+    calls = []
+
+    def init():
+        calls.append(1)
+        return {"w": jnp.zeros((2, 2))}
+
+    spec = ModelSpec.from_init_fn(init)
+    assert spec["w"].shape == (2, 2)
+
+
+def test_trainable_filter():
+    spec = ModelSpec(_params(), trainable_filter=lambda n: not n.startswith("emb"))
+    assert "emb/table" not in spec.trainable
+    assert "dense/w" in spec.trainable
+
+
+def test_sparse_detection_embedding_lookup():
+    """A param consumed only via take/gather is row-sparse (reference IndexedSlices)."""
+    params = _params()
+
+    def loss(p, idx, x):
+        e = jnp.take(p["emb"]["table"], idx, axis=0)       # embedding lookup
+        h = x @ p["dense"]["w"] + p["dense"]["b"]
+        return jnp.sum(e) + jnp.sum(h)
+
+    idx = np.array([1, 2, 3])
+    x = np.ones((2, 4), np.float32)
+    sparse = detect_sparse_params(loss, params, idx, x)
+    assert sparse == ["emb/table"]
+
+    spec = ModelSpec.from_loss_fn(loss, params, idx, x)
+    assert spec["emb/table"].sparse
+    assert not spec["dense/w"].sparse
+
+
+def test_dense_use_disables_sparse_detection():
+    params = {"table": jnp.zeros((10, 4))}
+
+    def loss(p, idx):
+        # gather AND a dense use -> dense gradient
+        return jnp.sum(jnp.take(p["table"], idx, axis=0)) + jnp.sum(p["table"])
+
+    assert detect_sparse_params(loss, params, np.array([0, 1])) == []
