@@ -1,0 +1,147 @@
+"""User API: the AutoDist class.
+
+Surface parity with reference ``autodist/autodist.py``:
+
+- ``AutoDist(resource_spec_file, strategy_builder)`` with PSLoadBalancing as the
+  default builder (reference ``autodist.py:70``).
+- ``scope()`` context manager around single-device model code (``:309-322``). In JAX
+  nothing needs monkey patching (the reference patched optimizers/Keras inside the
+  scope, ``patch.py``); the scope sets the process-default instance and marks the
+  capture phase.
+- ``build_strategy()`` / the chief-build-or-worker-load handshake keyed by
+  ``AUTODIST_STRATEGY_ID`` (``:100-109``) — the serialized strategy is what ships to
+  worker processes.
+- ``create_distributed_session(...)`` -> :class:`DistributedRunner` (``:191-198``).
+- ``function(...)`` -> a cached step callable (``:269-289``), the TF2-style path the
+  lm1b example uses.
+"""
+
+import contextlib
+from typing import Any, Callable, Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runner import DistributedRunner
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder, StrategyCompiler
+from autodist_tpu.utils import logging
+
+_default_autodist = None
+
+
+def set_default_autodist(ad: "AutoDist"):
+    global _default_autodist
+    _default_autodist = ad
+
+
+def get_default_autodist() -> Optional["AutoDist"]:
+    return _default_autodist
+
+
+class AutoDist:
+    """Entry point: resource spec + strategy builder -> distributed execution."""
+
+    def __init__(self, resource_spec_file: Optional[str] = None,
+                 strategy_builder: Optional[StrategyBuilder] = None):
+        from autodist_tpu.strategy import PSLoadBalancing
+        self._resource_spec = ResourceSpec(resource_spec_file)
+        self._strategy_builder = strategy_builder or PSLoadBalancing()
+        self._strategy: Optional[Strategy] = None
+        self._compiled: Optional[Strategy] = None
+        self._model_signature = None
+        set_default_autodist(self)
+
+    @property
+    def resource_spec(self) -> ResourceSpec:
+        return self._resource_spec
+
+    @property
+    def is_chief(self) -> bool:
+        """Chief/worker role split via AUTODIST_WORKER env (reference autodist.py:40-41)."""
+        return not const.ENV.AUTODIST_WORKER.val
+
+    @contextlib.contextmanager
+    def scope(self):
+        """Graph-capture scope (reference autodist.py:309-322). In JAX the model code
+        inside needs no rewriting; the scope installs this instance as the process
+        default so library code can find it."""
+        prev = get_default_autodist()
+        set_default_autodist(self)
+        try:
+            yield self
+        finally:
+            set_default_autodist(prev)
+
+    # ----------------------------------------------------------------- strategy
+    def build_strategy(self, model_spec: ModelSpec) -> Strategy:
+        """Build (chief) or load (worker) the strategy (reference autodist.py:91-109)."""
+        if self._strategy is not None:
+            return self._strategy
+        if self.is_chief:
+            self._strategy = self._strategy_builder.build(model_spec, self._resource_spec)
+            path = self._strategy.serialize()
+            logging.info("Built strategy %s -> %s", self._strategy.id, path)
+        else:
+            strategy_id = const.ENV.AUTODIST_STRATEGY_ID.val
+            if not strategy_id:
+                raise RuntimeError(
+                    "Worker process has no AUTODIST_STRATEGY_ID; the coordinator "
+                    "must ship the chief's strategy id")
+            self._strategy = Strategy.deserialize(strategy_id)
+            logging.info("Loaded strategy %s (worker)", strategy_id)
+        return self._strategy
+
+    def _compile(self, model_spec: ModelSpec) -> Strategy:
+        # One model per AutoDist instance, like the reference's single cached graph
+        # (autodist.py:280-287): reusing a strategy built for a different model would
+        # silently mis-distribute it, so that is an error.
+        signature = tuple(sorted((n, p.shape) for n, p in model_spec.trainable.items()))
+        if self._compiled is not None and signature != self._model_signature:
+            raise RuntimeError(
+                "This AutoDist instance already compiled a strategy for a different "
+                "model; create a new AutoDist per model (one-model-per-instance, as "
+                "in the reference)")
+        if self._compiled is None:
+            strategy = self.build_strategy(model_spec)
+            self._compiled = StrategyCompiler(model_spec, self._resource_spec).compile(strategy)
+            self._model_signature = signature
+        return self._compiled
+
+    # ------------------------------------------------------------------ session
+    def create_distributed_session(self, loss_fn: Callable, params: Any, optimizer,
+                                   example_batch: Any = None,
+                                   sparse_names: Optional[Sequence[str]] = None,
+                                   has_aux: bool = False) -> DistributedRunner:
+        """Compile the strategy for this model and return the runner
+        (reference autodist.py:191-198 returned the wrapped session)."""
+        model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
+        compiled = self._compile(model_spec)
+        return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
+                                 has_aux=has_aux)
+
+    def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
+        if sparse_names is not None:
+            return ModelSpec(params, sparse_names=sparse_names)
+        if example_batch is not None:
+            return ModelSpec.from_loss_fn(loss_fn, params, example_batch)
+        return ModelSpec(params)
+
+    # ----------------------------------------------------------------- function
+    def function(self, loss_fn: Callable, params: Any, optimizer,
+                 example_batch: Any = None, sparse_names: Optional[Sequence[str]] = None,
+                 has_aux: bool = False) -> Callable:
+        """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
+        internally (reference autodist.py:252-289 cached a built runner the same
+        way: first call builds, later calls reuse)."""
+        runner = self.create_distributed_session(
+            loss_fn, params, optimizer, example_batch, sparse_names, has_aux)
+        state = runner.init(params)
+
+        def step(batch):
+            nonlocal state
+            state, fetched = runner.run(state, batch)
+            return fetched
+
+        step.runner = runner
+        step.get_state = lambda: state
+        return step

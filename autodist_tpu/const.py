@@ -35,34 +35,46 @@ MAX_INT32 = 2**31 - 1
 MAX_INT64 = 2**63 - 1
 
 
+# Defaults for the ENV enum below. Kept outside the enum body: members whose values
+# compare equal would silently become enum *aliases* (all reading the first member's
+# env var), so each member's value is its own name.
+_ENV_DEFAULTS = {
+    "AUTODIST_WORKER": "",                 # non-empty => this process is a worker
+    "AUTODIST_STRATEGY_ID": "",            # strategy id shipped by the chief
+    "AUTODIST_MIN_LOG_LEVEL": "INFO",
+    "AUTODIST_IS_TESTING": False,          # extra invariants under test
+    "AUTODIST_DEBUG_REMOTE": False,        # verbose remote launch logging
+    "AUTODIST_INTERNAL_TF": False,         # kept for API parity (no-op on TPU)
+    "AUTODIST_PATCH_TF": False,            # kept for API parity (no-op on TPU)
+    "SYS_DATA_PATH": "",
+    "SYS_RESOURCE_PATH": "",
+    # TPU-native additions: multi-host bootstrap (replaces tf.Server membership).
+    "AUTODIST_COORDINATOR_ADDR": "",       # "ip:port" of jax.distributed coordinator
+    "AUTODIST_NUM_PROCESSES": 1,
+    "AUTODIST_PROCESS_ID": 0,
+}
+
 class ENV(enum.Enum):
-    """Typed environment variables with defaults (reference const.py:55-89).
+    """Typed environment variables with defaults (reference const.py:55-89)."""
 
-    Each member's value is a lambda evaluating the default; ``.val`` reads the
-    environment with fallback.
-    """
-
-    # Values are 1-tuples holding the default (a bare callable would become an enum
-    # method rather than a member).
-    AUTODIST_WORKER = ("",)                    # non-empty => this process is a worker
-    AUTODIST_STRATEGY_ID = ("",)               # strategy id shipped by the chief
-    AUTODIST_MIN_LOG_LEVEL = ("INFO",)
-    AUTODIST_IS_TESTING = (False,)             # extra invariants under test
-    AUTODIST_DEBUG_REMOTE = (False,)           # verbose remote launch logging
-    AUTODIST_INTERNAL_TF = (False,)            # kept for API parity (no-op on TPU)
-    AUTODIST_PATCH_TF = (False,)               # kept for API parity (no-op on TPU)
-    SYS_DATA_PATH = ("",)
-    SYS_RESOURCE_PATH = ("",)
-    # TPU-native additions: multi-host bootstrap (replaces tf.Server cluster membership).
-    AUTODIST_COORDINATOR_ADDR = ("",)          # "ip:port" of jax.distributed coordinator
-    AUTODIST_NUM_PROCESSES = (1,)
-    AUTODIST_PROCESS_ID = (0,)
+    AUTODIST_WORKER = "AUTODIST_WORKER"
+    AUTODIST_STRATEGY_ID = "AUTODIST_STRATEGY_ID"
+    AUTODIST_MIN_LOG_LEVEL = "AUTODIST_MIN_LOG_LEVEL"
+    AUTODIST_IS_TESTING = "AUTODIST_IS_TESTING"
+    AUTODIST_DEBUG_REMOTE = "AUTODIST_DEBUG_REMOTE"
+    AUTODIST_INTERNAL_TF = "AUTODIST_INTERNAL_TF"
+    AUTODIST_PATCH_TF = "AUTODIST_PATCH_TF"
+    SYS_DATA_PATH = "SYS_DATA_PATH"
+    SYS_RESOURCE_PATH = "SYS_RESOURCE_PATH"
+    AUTODIST_COORDINATOR_ADDR = "AUTODIST_COORDINATOR_ADDR"
+    AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
+    AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
 
     @property
     def val(self):
         """Return the env value, parsed to the default's type when set."""
         raw = os.environ.get(self.name)
-        default = self.value[0]
+        default = _ENV_DEFAULTS[self.name]
         if raw is None:
             return default
         if isinstance(default, bool):

@@ -1,0 +1,152 @@
+"""Gradient synchronization: the synchronizer kernels, TPU-native.
+
+Reference counterparts:
+
+- ``kernel/synchronization/all_reduce_synchronizer.py:102-130`` wrapped each gradient
+  in ``collective_ops.all_reduce`` through a Compressor. Here the uncompressed path
+  is simply the implicit psum XLA inserts for a sharded-batch ``value_and_grad``;
+  the compressed path uses ``jax.shard_map`` so the cross-replica mean really rides
+  the compressed (bfloat16) representation over ICI.
+- ``kernel/synchronization/compressor.py``: ``NoneCompressor`` (:146-166),
+  ``HorovodCompressor`` (:169-201, a dtype-cast codec) and ``HorovodCompressorEF``
+  (:120-143, error feedback) map to NONE / BF16 / BF16_EF.
+- PS synchronizers need no explicit code here: weight-update sharding is expressed
+  entirely through the plan's opt-state shardings (XLA emits the reduce-scatter /
+  all-gather), replacing accumulators and token queues (``ps_synchronizer.py``).
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.parallel import plan as plan_lib
+from autodist_tpu.parallel.plan import COMP_BF16, COMP_BF16_EF, COMP_NONE, ShardingPlan
+
+PyTree = Any
+
+
+# --------------------------------------------------------------------- compressors
+
+def compress(x: jax.Array, kind: int) -> jax.Array:
+    if kind in (COMP_BF16, COMP_BF16_EF):
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def decompress(x: jax.Array, dtype) -> jax.Array:
+    return x.astype(dtype)
+
+
+# ------------------------------------------------------------------ grad functions
+
+def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
+                 loss_fn: Callable, has_aux: bool = False) -> Callable:
+    """Build ``grad_fn(params, batch, ef_state) -> (grads, loss, aux, new_ef_state)``.
+
+    Two lowerings:
+
+    - **Implicit** (no compressor anywhere): plain ``value_and_grad`` of the global
+      loss; the batch is sharded over the data axes, so XLA inserts the gradient
+      all-reduce (and, with sharded opt state, the reduce-scatter) itself.
+    - **Explicit** (some parameter has a compressor): ``jax.shard_map`` over the data
+      axes — each shard computes a local gradient, compresses, ``lax.pmean``s the
+      compressed payload so the wire format is bfloat16, then decompresses. Error
+      feedback keeps a residual per parameter: x = g + ef; send compress(x);
+      ef' = x - decompress(compress(x)).
+    """
+    if not sharding_plan.has_compression:
+        def implicit(params, batch, ef_state):
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                aux = ()
+            return grads, loss, aux, ef_state
+        return implicit
+
+    if not sharding_plan.all_params_replicated:
+        raise NotImplementedError(
+            "Gradient compression currently requires replicated parameters "
+            "(AllReduce-family strategies); partitioned parameters with a compressor "
+            "are not supported in one strategy")
+
+    from autodist_tpu.model_spec import _path_name as name_of
+    comp_by_name: Dict[str, int] = {n: p.compressor
+                                    for n, p in sharding_plan.params.items()}
+
+    def local_fn(params, batch, ef_state):
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            aux = ()
+
+        def synced_leaf(path, g, ef):
+            kind = comp_by_name.get(name_of(path), COMP_NONE)
+            if kind == COMP_NONE:
+                return jax.lax.pmean(g, plan_lib.DP_AXES)
+            payload = compress(g + ef, kind) if kind == COMP_BF16_EF else compress(g, kind)
+            return decompress(jax.lax.pmean(payload, plan_lib.DP_AXES), g.dtype)
+
+        def ef_leaf(path, g, ef):
+            kind = comp_by_name.get(name_of(path), COMP_NONE)
+            if kind != COMP_BF16_EF:
+                return ef
+            # Error feedback: x = g + ef; send compress(x); keep the residual.
+            x = g + ef
+            return x - decompress(compress(x, kind), g.dtype)
+
+        synced = jax.tree_util.tree_map_with_path(synced_leaf, grads, ef_state)
+        new_ef = jax.tree_util.tree_map_with_path(ef_leaf, grads, ef_state)
+        loss = jax.lax.pmean(loss, plan_lib.DP_AXES)
+        aux = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, plan_lib.DP_AXES), aux)
+        return synced, loss, aux, new_ef
+
+    batch_spec_fn = _batch_spec_maker(sharding_plan)
+
+    def explicit(params, batch, ef_state):
+        batch_specs = jax.tree_util.tree_map(batch_spec_fn, batch)
+        replicated = jax.tree_util.tree_map(lambda _: P(), params)
+        ef_specs = jax.tree_util.tree_map(lambda _: P(), ef_state)
+        out = jax.shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(replicated, batch_specs, ef_specs),
+            out_specs=(replicated, P(), P(), ef_specs),
+            check_vma=False,
+        )(params, batch, ef_state)
+        return out
+
+    return explicit
+
+
+def _batch_spec_maker(sharding_plan: ShardingPlan):
+    dp = sharding_plan.dp_size
+
+    def spec_for(leaf):
+        shape = getattr(leaf, "shape", ())
+        if shape and shape[0] % dp == 0:
+            return sharding_plan.batch_pspec(len(shape))
+        return P()
+
+    return spec_for
+
+
+def init_ef_state(sharding_plan: ShardingPlan, params: PyTree) -> PyTree:
+    """Zeros for every parameter using error feedback; 0-size scalars otherwise.
+
+    Shaped like ``params`` so it can ride the same sharding derivation. (Reference
+    kept the EF residual as Python-side state inside the compressor object,
+    ``compressor.py:120-143``; functionally it belongs in the train state.)
+    """
+    names = {n for n, p in sharding_plan.params.items() if p.compressor == COMP_BF16_EF}
+    from autodist_tpu.model_spec import _path_name
+
+    def leaf(path, x):
+        if _path_name(path) in names:
+            return jnp.zeros_like(x)
+        return jnp.zeros((), dtype=x.dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)

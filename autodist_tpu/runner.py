@@ -1,0 +1,164 @@
+"""Execution runtime: DistributedRunner (reference WrappedSession + Remapper).
+
+The reference's steady-state step (``runner.py:117-132``) ran a grpc session with a
+feed/fetch remapper splitting the host batch across replicas (``remapper.py:81-123``)
+and contracting fetches (``:125-185``). Here the step is one jitted SPMD program over
+the mesh:
+
+- feeds: host arrays whose leading dim is divisible by the data-parallel size are
+  device_put with the batch sharding (the split); everything else replicates (the
+  duplicate) — same polymorphism as the reference's Remapper.
+- fetches: the loss (and aux metrics) come back as replicated scalars — the
+  "master replica value" contraction is a no-op in SPMD.
+- initializers-at-construction (reference ``runner.py:97-100``) becomes
+  ``init(params)``: placing params/opt-state/EF-state onto the mesh per the plan.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.parallel import synchronization
+from autodist_tpu.parallel.mesh import build_mesh
+from autodist_tpu.parallel.plan import ShardingPlan
+from autodist_tpu.utils import logging
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class TrainState:
+    """One training step's carried state (a pytree)."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    ef_state: PyTree   # error-feedback residuals (zeros-scalars when unused)
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["step", "params", "opt_state", "ef_state"], meta_fields=[])
+
+
+class DistributedRunner:
+    """Compiles and runs the distributed train step for one (strategy, model).
+
+    Counterpart of reference ``WrappedSession`` (``runner.py:78-132``): constructed
+    from the *compiled* strategy, owns the mesh, shards state, steps batches.
+    """
+
+    def __init__(self, compiled_strategy, model_spec: ModelSpec, loss_fn: Callable,
+                 optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
+                 donate_state: bool = True):
+        self._model_spec = model_spec
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._has_aux = has_aux
+        self._donate = donate_state
+        self.plan = ShardingPlan.from_strategy(compiled_strategy, model_spec)
+        self.mesh = mesh if mesh is not None else self._mesh_from_plan()
+        self._grad_fn = synchronization.make_grad_fn(
+            self.plan, model_spec, self.mesh, loss_fn, has_aux=has_aux)
+        self._step_fn = None
+        self._state_shardings = None
+
+    def _mesh_from_plan(self) -> Mesh:
+        axes = dict(self.plan.mesh_axes)
+        n = len(jax.devices())
+        if int(np.prod(list(axes.values()))) != n:
+            # Strategy was built for a different device count (e.g. compiled on the
+            # chief for the full pod, now dry-running on fewer chips): refill data,
+            # then shrink the largest remaining axis until the product divides n.
+            logging.warning(
+                "Strategy mesh %s does not match %d local devices; refilling data axis",
+                axes, n)
+            axes.pop("data", None)
+            axes = {a: s for a, s in axes.items() if s > 1}
+            while axes and n % int(np.prod(list(axes.values()))) != 0:
+                largest = max(axes, key=axes.__getitem__)
+                axes.pop(largest)
+            axes["data"] = -1
+        return build_mesh(axes=axes)
+
+    # ------------------------------------------------------------------- state
+    def init(self, params: PyTree, rng: Optional[jax.Array] = None) -> TrainState:
+        """Place initial state onto the mesh (reference ran initializers at session
+        construction, runner.py:97-100)."""
+        opt_state = self._optimizer.init(params)
+        ef_state = synchronization.init_ef_state(self.plan, params)
+        p_sh = self.plan.param_sharding_tree(self.mesh, params)
+        o_sh = self.plan.opt_sharding_tree(self.mesh, opt_state)
+        e_sh = jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()), ef_state)
+        self._state_shardings = TrainState(
+            step=NamedSharding(self.mesh, P()), params=p_sh, opt_state=o_sh, ef_state=e_sh)
+        state = TrainState(step=np.zeros((), np.int32), params=params,
+                           opt_state=opt_state, ef_state=ef_state)
+        # Jitted identity with out_shardings: places the state on the mesh AND
+        # guarantees fresh buffers (a plain device_put may alias caller-owned arrays,
+        # which step donation would then delete out from under the caller).
+        place = jax.jit(lambda s: s, out_shardings=self._state_shardings)
+        with self.mesh:
+            return place(state)
+
+    # -------------------------------------------------------------------- step
+    def _build_step(self):
+        optimizer = self._optimizer
+        grad_fn = self._grad_fn
+
+        def step_fn(state: TrainState, batch: PyTree):
+            grads, loss, aux, ef_state = grad_fn(state.params, batch, state.ef_state)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(step=state.step + 1, params=params,
+                                   opt_state=opt_state, ef_state=ef_state)
+            return new_state, (loss, aux)
+
+        donate = (0,) if self._donate else ()
+        self._step_fn = jax.jit(
+            step_fn,
+            in_shardings=(self._state_shardings, None),
+            out_shardings=(self._state_shardings, None),
+            donate_argnums=donate,
+        )
+
+    def shard_batch(self, batch: PyTree) -> PyTree:
+        """Feed remapping: split batch leaves across data replicas, duplicate the
+        rest (reference remapper.py:81-123 semantics, with the polymorphic dim now
+        'leading dim divisible by dp_size')."""
+        dp = self.plan.dp_size
+
+        def put(leaf):
+            shape = getattr(leaf, "shape", None)
+            if shape is None:
+                leaf = np.asarray(leaf)
+                shape = leaf.shape
+            if len(shape) >= 1 and shape[0] % dp == 0:
+                spec = self.plan.batch_pspec(len(shape))
+            else:
+                spec = P()
+            sharding = NamedSharding(self.mesh, spec)
+            if isinstance(leaf, jax.Array) and leaf.sharding == sharding:
+                return leaf  # already resident with the right layout — no transfer
+            return jax.device_put(leaf, sharding)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def run(self, state: TrainState, batch: PyTree) -> Tuple[TrainState, Any]:
+        """One synchronized training step. Returns (new_state, fetches)."""
+        if self._state_shardings is None:
+            raise RuntimeError("Call init(params) before run()")
+        if self._step_fn is None:
+            self._build_step()
+        with self.mesh:
+            new_state, (loss, aux) = self._step_fn(state, self.shard_batch(batch))
+        if self._has_aux:
+            return new_state, (loss, aux)
+        return new_state, loss
+
+    # Convenience parity alias: session.run(...)
+    __call__ = run

@@ -86,3 +86,14 @@ def test_local_default_spec_matches_visible_devices():
 def test_mesh_section_parsed():
     spec = ResourceSpec("{nodes: [{address: a, tpus: 8}], mesh: {data: 2, model: 4}}")
     assert spec.mesh_config == {"data": 2, "model": 4}
+
+
+def test_env_members_are_distinct(monkeypatch):
+    """Guard against enum aliasing: members with equal values would silently read
+    each other's env vars."""
+    from autodist_tpu.const import ENV, _ENV_DEFAULTS
+    assert len(list(ENV)) == len(_ENV_DEFAULTS)
+    monkeypatch.setenv("AUTODIST_WORKER", "1.2.3.4")
+    assert ENV.AUTODIST_STRATEGY_ID.val == ""
+    assert ENV.AUTODIST_WORKER.val == "1.2.3.4"
+    assert ENV.AUTODIST_NUM_PROCESSES.val == 1
