@@ -17,7 +17,7 @@ Surface parity with reference ``autodist/autodist.py``:
 """
 
 import contextlib
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence, Union
 
 from autodist_tpu import const
 from autodist_tpu.model_spec import ModelSpec
@@ -41,10 +41,15 @@ def get_default_autodist() -> Optional["AutoDist"]:
 class AutoDist:
     """Entry point: resource spec + strategy builder -> distributed execution."""
 
-    def __init__(self, resource_spec_file: Optional[str] = None,
+    def __init__(self, resource_spec_file: Union[str, ResourceSpec, None] = None,
                  strategy_builder: Optional[StrategyBuilder] = None):
+        """``resource_spec_file``: YAML path, inline YAML text, an already-parsed
+        :class:`ResourceSpec`, or None for the local-devices default."""
         from autodist_tpu.strategy import PSLoadBalancing
-        self._resource_spec = ResourceSpec(resource_spec_file)
+        if isinstance(resource_spec_file, ResourceSpec):
+            self._resource_spec = resource_spec_file
+        else:
+            self._resource_spec = ResourceSpec(resource_spec_file)
         self._strategy_builder = strategy_builder or PSLoadBalancing()
         self._strategy: Optional[Strategy] = None
         self._compiled: Optional[Strategy] = None

@@ -1,0 +1,21 @@
+"""Model zoo — the training workloads the reference benchmarked.
+
+Counterparts of the reference's examples/benchmark model set (SURVEY.md §2.3):
+linear regression smoke (``examples/linear_regression.py``), image classifiers
+(``examples/benchmark/imagenet.py``: ResNet/VGG), the lm1b language model
+(``examples/lm1b/``), BERT pretraining (``examples/benchmark/bert.py``), and the NCF
+recommender (``examples/benchmark/ncf.py``). All are implemented TPU-first: static
+shapes, bf16-friendly matmuls sized for the MXU, no data-dependent Python control
+flow inside jit.
+"""
+
+from autodist_tpu.models.transformer_lm import TransformerLM, TransformerLMConfig
+from autodist_tpu.models.resnet import ResNet, ResNet50Config
+from autodist_tpu.models.bert import Bert, BertConfig
+from autodist_tpu.models.vgg import VGG16
+from autodist_tpu.models.ncf import NeuMF, NeuMFConfig
+
+__all__ = [
+    "TransformerLM", "TransformerLMConfig", "ResNet", "ResNet50Config",
+    "Bert", "BertConfig", "VGG16", "NeuMF", "NeuMFConfig",
+]

@@ -1,0 +1,108 @@
+"""BERT encoder with masked-LM pretraining loss.
+
+Counterpart of the reference BERT pretraining benchmark (``examples/benchmark/
+bert.py:41-47,194-215`` + ``utils/modeling``). Encoder-only Transformer sharing the
+TPU-first layout of :mod:`transformer_lm` (bf16 activations, f32 params, static
+shapes); the MLM objective gathers prediction positions statically.
+"""
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.transformer_lm import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    dtype: Any = jnp.bfloat16
+
+
+class EncoderBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        cfg = self.config
+        head_dim = cfg.d_model // cfg.n_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(cfg.n_heads, head_dim), axis=-1, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name, use_bias=True)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        q, k, v = dense("query")(h), dense("key")(h), dense("value")(h)
+        ctx = dot_product_attention(q, k, v, pad_mask, cfg.dtype)
+        attn = nn.DenseGeneral(features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                               param_dtype=jnp.float32, name="out")(ctx)
+        x = x + attn
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
+        h = nn.gelu(nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
+                             name="mlp_in")(h))
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(h)
+        return x + h
+
+
+class Bert(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types):
+        cfg = self.config
+        _, length = tokens.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="embed")
+        type_emb = nn.Embed(cfg.type_vocab, cfg.d_model, dtype=cfg.dtype,
+                            param_dtype=jnp.float32, name="type_embed")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.d_model), jnp.float32)
+        x = emb(tokens) + type_emb(token_types) + pos[None, :length, :].astype(cfg.dtype)
+        # Additive pad mask: 0 where attendable, -1e9 at pad columns ([B,1,1,L] is
+        # broadcast over heads and query positions).
+        pad = (tokens == 0)
+        pad_mask = jnp.where(pad[:, None, None, :], jnp.full((), -1e9, cfg.dtype),
+                             jnp.zeros((), cfg.dtype))
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, pad_mask)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        return emb.attend(x.astype(jnp.float32))  # tied MLM logits
+
+
+def make_mlm_loss_fn(model: Bert) -> Callable:
+    """Masked-LM loss; batch = tokens, token_types, mlm_positions, mlm_targets,
+    mlm_weights (static-count prediction slots, TPU-friendly like the reference's
+    fixed max_predictions_per_seq)."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"], batch["token_types"])
+        pos = batch["mlm_positions"]                      # [B, P]
+        logits_at = jnp.take_along_axis(logits, pos[..., None], axis=1)   # [B, P, V]
+        logprobs = jax.nn.log_softmax(logits_at, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, batch["mlm_targets"][..., None],
+                                   axis=-1)[..., 0]
+        w = batch["mlm_weights"].astype(nll.dtype)
+        return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+    return loss_fn
+
+
+def synthetic_batch(config: BertConfig, batch_size: int, seq_len: int = 128,
+                    n_predictions: int = 20, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "tokens": rng.randint(1, config.vocab_size, size=(batch_size, seq_len)).astype(np.int32),
+        "token_types": np.zeros((batch_size, seq_len), np.int32),
+        "mlm_positions": rng.randint(0, seq_len, size=(batch_size, n_predictions)).astype(np.int32),
+        "mlm_targets": rng.randint(1, config.vocab_size, size=(batch_size, n_predictions)).astype(np.int32),
+        "mlm_weights": np.ones((batch_size, n_predictions), np.float32),
+    }

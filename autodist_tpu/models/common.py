@@ -1,0 +1,18 @@
+"""Shared loss helpers for the model zoo."""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def make_classification_loss_fn(model) -> Callable:
+    """Softmax cross entropy over {"images", "labels"} batches (ResNet/VGG style)."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["images"])
+        logprobs = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logprobs, batch["labels"][:, None], axis=-1)[:, 0]
+        return nll.mean()
+
+    return loss_fn

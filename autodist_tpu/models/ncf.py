@@ -1,0 +1,65 @@
+"""NeuMF recommender — the sparse-gradient-heavy benchmark.
+
+Counterpart of the reference NCF benchmark (``examples/benchmark/ncf.py`` +
+``utils/recommendation``): two embedding pairs (GMF + MLP towers) whose gradients
+are row-sparse, exercising the PS/Parallax sparse path the same way the reference's
+``SparseConditionalAccumulator`` did.
+"""
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class NeuMFConfig:
+    num_users: int = 138_000
+    num_items: int = 27_000
+    mf_dim: int = 64
+    mlp_dims: Sequence[int] = (256, 128, 64)
+    dtype: Any = jnp.float32
+
+
+class NeuMF(nn.Module):
+    config: NeuMFConfig
+
+    @nn.compact
+    def __call__(self, users, items):
+        cfg = self.config
+        embed = lambda n, d, name: nn.Embed(  # noqa: E731
+            n, d, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        mf_u = embed(cfg.num_users, cfg.mf_dim, "mf_user_embed")(users)
+        mf_i = embed(cfg.num_items, cfg.mf_dim, "mf_item_embed")(items)
+        mlp_u = embed(cfg.num_users, cfg.mlp_dims[0] // 2, "mlp_user_embed")(users)
+        mlp_i = embed(cfg.num_items, cfg.mlp_dims[0] // 2, "mlp_item_embed")(items)
+
+        gmf = mf_u * mf_i
+        x = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+        for i, d in enumerate(cfg.mlp_dims[1:]):
+            x = nn.relu(nn.Dense(d, dtype=cfg.dtype, param_dtype=jnp.float32,
+                                 name=f"mlp_{i}")(x))
+        both = jnp.concatenate([gmf, x], axis=-1)
+        return nn.Dense(1, dtype=jnp.float32, name="head")(both)[..., 0]
+
+
+def make_loss_fn(model: NeuMF) -> Callable:
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["users"], batch["items"])
+        labels = batch["labels"].astype(jnp.float32)
+        # Numerically stable sigmoid cross entropy.
+        return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return loss_fn
+
+
+def synthetic_batch(config: NeuMFConfig, batch_size: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "users": rng.randint(0, config.num_users, size=(batch_size,)).astype(np.int32),
+        "items": rng.randint(0, config.num_items, size=(batch_size,)).astype(np.int32),
+        "labels": rng.randint(0, 2, size=(batch_size,)).astype(np.float32),
+    }

@@ -1,0 +1,99 @@
+"""ResNet for ImageNet-class benchmarks.
+
+Counterpart of the reference's ImageNet CNN benchmark models
+(``examples/benchmark/imagenet.py:150-170`` ran Keras ResNet101/VGG16/DenseNet121/
+InceptionV3; the driver's north-star config is ResNet-50). TPU-first choices:
+NHWC layout, bfloat16 activations with float32 params, and GroupNorm instead of
+BatchNorm so the train step stays a pure function of (params, batch) — no mutable
+running statistics to thread through the distributed state (cross-replica BatchNorm
+would otherwise need its own sync path).
+"""
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNet50Config:
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)      # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    norm_groups: int = 32
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    config: ResNet50Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        norm = lambda name: nn.GroupNorm(  # noqa: E731
+            num_groups=min(cfg.norm_groups, self.filters), dtype=cfg.dtype, name=name)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name="conv1")(x)
+        y = nn.relu(norm("norm1")(y))
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+                    name="conv2")(y)
+        y = nn.relu(norm("norm2")(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name="conv3")(y)
+        y = nn.GroupNorm(num_groups=min(cfg.norm_groups, self.filters * 4),
+                         dtype=cfg.dtype, name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               strides=(self.strides, self.strides), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=jnp.float32,
+                               name="proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNet50Config
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=jnp.float32, name="conv_init")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=min(cfg.norm_groups, cfg.width),
+                                 dtype=cfg.dtype, name="norm_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(cfg.width * 2 ** stage, strides, cfg,
+                                    name=f"stage{stage}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def make_loss_fn(model: ResNet) -> Callable:
+    from autodist_tpu.models.common import make_classification_loss_fn
+    return make_classification_loss_fn(model)
+
+
+def init_params(config: ResNet50Config, rng=None, image_size: int = 224):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = ResNet(config)
+    images = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
+    return model, model.init(rng, images)["params"]
+
+
+def synthetic_batch(config: ResNet50Config, batch_size: int, image_size: int = 224,
+                    seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "images": rng.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "labels": rng.randint(0, config.num_classes, size=(batch_size,)).astype(np.int32),
+    }

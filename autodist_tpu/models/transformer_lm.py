@@ -1,0 +1,169 @@
+"""Decoder-only Transformer language model — the flagship workload.
+
+Fills the role of the reference's lm1b language model (``examples/lm1b/
+language_model.py:15-30``: LSTM + 793k-vocab sampled softmax), re-designed for TPU:
+a decoder-only Transformer whose matmuls are MXU-shaped, activations in bfloat16
+with float32 parameters, optional ``jax.checkpoint`` rematerialization to trade
+FLOPs for HBM, and an attention hook so sequence-parallel (ring) attention can swap
+in. The embedding table is the sparse-gradient parameter the Parallax strategy
+routes to PS (reference routed lm1b's embedding the same way).
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLMConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 1024
+    dropout: float = 0.0          # deterministic by default (benchmark parity)
+    dtype: Any = jnp.bfloat16     # activation/compute dtype (params stay f32)
+    remat: bool = False           # jax.checkpoint each block
+    attention_impl: str = "dot"   # "dot" | "flash" | "ring" (see ops/, parallel/)
+    # Tie input embedding and output projection. Untied matches the reference lm1b
+    # model (separate sampled-softmax weights, language_model.py:15-30) and keeps the
+    # embedding gather-only, so its gradient is row-sparse and Parallax routes it to
+    # PS; tied halves the parameters but makes the embedding gradient dense.
+    tied_output: bool = True
+
+    def __post_init__(self):
+        if self.attention_impl not in ("dot", "flash", "ring"):
+            raise ValueError(f"Unknown attention_impl {self.attention_impl!r}; "
+                             f"valid: 'dot', 'flash', 'ring'")
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+
+
+def causal_mask(length: int, dtype) -> jax.Array:
+    # Static lower-triangular mask; -inf encoded as large negative for bf16 safety.
+    mask = jnp.tril(jnp.ones((length, length), dtype=bool))
+    return jnp.where(mask, jnp.zeros((), dtype), jnp.full((), -1e9, dtype))
+
+
+def dot_product_attention(q, k, v, mask, dtype):
+    """Plain softmax attention: the baseline the pallas flash kernel replaces.
+
+    ``mask`` is additive and broadcastable to [B, H, Q, K] (a [Q, K] causal mask or
+    a [B, 1, 1, K] padding mask both work).
+    """
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(dtype)
+    scores = scores + mask
+    # Softmax in f32 for stability, results back to compute dtype.
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    config: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        head_dim = cfg.d_model // cfg.n_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(cfg.n_heads, head_dim), axis=-1, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name, use_bias=False)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+
+        if cfg.attention_impl == "flash":
+            from autodist_tpu.ops.flash_attention import flash_attention
+            ctx = flash_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "ring":
+            from autodist_tpu.parallel.ring_attention import ring_attention
+            ctx = ring_attention(q, k, v, causal=True)
+        else:  # "dot" (config validates the value set)
+            ctx = dot_product_attention(q, k, v, mask, cfg.dtype)
+
+        return nn.DenseGeneral(features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                               param_dtype=jnp.float32, name="out", use_bias=False)(ctx)
+
+
+class Block(nn.Module):
+    config: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(h, mask)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_in", use_bias=False)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_out", use_bias=False)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    config: TransformerLMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        _, length = tokens.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="embed")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.d_model), jnp.float32)
+        x = emb(tokens) + pos[None, :length, :].astype(cfg.dtype)
+        mask = causal_mask(length, cfg.dtype)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block_{i}")(x, mask)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # Logits in f32 for a stable softmax/xent.
+        if cfg.tied_output:
+            return emb.attend(x.astype(jnp.float32))
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                        name="lm_head")(x.astype(jnp.float32))
+
+
+def make_loss_fn(model: TransformerLM) -> Callable:
+    """Next-token cross entropy; batch = {"tokens": int32 [B, L+1]} (inputs/targets
+    shifted internally). Matches the reference's lm1b objective shape (words/sec is
+    counted over target tokens, lm1b_train.py:64-74)."""
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply({"params": params}, inputs)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        if "mask" in batch:
+            mask = batch["mask"][:, 1:].astype(nll.dtype)
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return nll.mean()
+
+    return loss_fn
+
+
+def init_params(config: TransformerLMConfig, rng: Optional[jax.Array] = None,
+                batch_size: int = 2):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = TransformerLM(config)
+    tokens = jnp.zeros((batch_size, min(8, config.max_len)), jnp.int32)
+    variables = model.init(rng, tokens)
+    return model, variables["params"]
+
+
+def synthetic_batch(config: TransformerLMConfig, batch_size: int, seq_len: int,
+                    seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {"tokens": rng.randint(0, config.vocab_size,
+                                  size=(batch_size, seq_len + 1)).astype(np.int32)}

@@ -1,0 +1,40 @@
+"""VGG-16 — the dense-parameter-heavy benchmark model.
+
+The reference used VGG16 as the PS/partitioning stress case (its ~500MB of dense fc
+weights are why ``PartitionedPS`` exists; chunk-size tuning at
+``examples/benchmark/imagenet.py:150-160``). The huge fc layers are exactly what the
+partitioned strategies shard across the mesh.
+"""
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: type = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images):
+        x = images.astype(self.dtype)
+        for stage, (filters, convs) in enumerate(
+                [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]):
+            for c in range(convs):
+                x = nn.relu(nn.Conv(filters, (3, 3), dtype=self.dtype,
+                                    param_dtype=jnp.float32,
+                                    name=f"conv{stage}_{c}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
+                             name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
+                             name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def make_loss_fn(model: VGG16) -> Callable:
+    from autodist_tpu.models.common import make_classification_loss_fn
+    return make_classification_loss_fn(model)

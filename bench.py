@@ -1,0 +1,79 @@
+"""Flagship benchmark: Transformer LM training throughput on the active platform.
+
+Reproduces the reference's own measurement procedure (BASELINE.md): the lm1b
+words/sec hook (``examples/lm1b/lm1b_train.py:64-74`` printed wps per 100 steps)
+re-targeted at the flagship Transformer LM. Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N}
+
+The reference publishes no numeric table (figures only), so ``vs_baseline``
+normalizes against the BASELINE.md procedural target: V100-class per-device lm1b
+throughput, taken as 20k words/sec/device (the upper end of published LSTM-lm1b
+single-V100 numbers; the north star is per-chip >= that).
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC_PER_DEVICE = 20_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+
+    # lm1b-class flagship config; bf16 activations on accelerators.
+    on_accel = platform != "cpu"
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=32_000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
+        max_len=512, dtype=jnp.bfloat16 if on_accel else jnp.float32,
+        tied_output=False)
+    seq_len = 256 if on_accel else 64
+    batch_size = (32 if on_accel else 8) * n_dev
+
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size, seq_len=seq_len)
+
+    ad = AutoDist(strategy_builder=AllReduce())
+    step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)
+
+    # Warmup (compile + first dispatch), then timed steps. The final host read is
+    # the sync barrier: the last loss depends on the whole state chain, and a
+    # device->host transfer is a reliable completion fence even on experimental
+    # platforms where block_until_ready has proven optimistic.
+    for _ in range(2):
+        loss = step(batch)
+    _ = float(loss)
+    n_steps = 20 if on_accel else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(batch)
+    _ = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq_len
+    tokens_per_sec = tokens_per_step * n_steps / dt
+    per_device = tokens_per_sec / n_dev
+
+    print(json.dumps({
+        "metric": f"transformer_lm_train_tokens_per_sec ({platform} x{n_dev}, "
+                  f"d{cfg.d_model}x{cfg.n_layers}, seq{seq_len}, bs{batch_size})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(per_device / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
