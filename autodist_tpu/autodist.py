@@ -54,6 +54,8 @@ class AutoDist:
         self._strategy: Optional[Strategy] = None
         self._compiled: Optional[Strategy] = None
         self._model_signature = None
+        self._cluster = None
+        self._coordinator = None
         set_default_autodist(self)
 
     @property
@@ -113,6 +115,35 @@ class AutoDist:
         return self._compiled
 
     # ------------------------------------------------------------------ session
+    def _setup(self, strategy):
+        """Multi-node setup on first session creation (reference autodist.py:120-128):
+        start the cluster, chief launches worker replicas of the user script, every
+        process joins the jax.distributed coordination service."""
+        if self._cluster is not None or self._resource_spec.num_nodes <= 1:
+            return
+        from autodist_tpu.cluster import Cluster
+        from autodist_tpu.coordinator import Coordinator
+        from autodist_tpu.parallel.multihost import maybe_initialize_multihost
+        self._cluster = Cluster(self._resource_spec)
+        self._cluster.start()
+        if self.is_chief:
+            self._coordinator = Coordinator(strategy, self._cluster)
+            self._coordinator.launch_clients()
+        maybe_initialize_multihost(self._cluster)
+        import atexit
+        atexit.register(self._teardown)
+
+    def _teardown(self):
+        """Teardown ordering parity (reference autodist.py:178-183): coordinator
+        join (bounded — an abnormal chief exit must not deadlock on workers stuck in
+        a collective), then cluster terminate."""
+        try:
+            if self._coordinator is not None:
+                self._coordinator.join(timeout=10.0)
+        finally:
+            if self._cluster is not None:
+                self._cluster.terminate()
+
     def create_distributed_session(self, loss_fn: Callable, params: Any, optimizer,
                                    example_batch: Any = None,
                                    sparse_names: Optional[Sequence[str]] = None,
@@ -120,6 +151,8 @@ class AutoDist:
         """Compile the strategy for this model and return the runner
         (reference autodist.py:191-198 returned the wrapped session)."""
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
+        strategy = self.build_strategy(model_spec)
+        self._setup(strategy)
         compiled = self._compile(model_spec)
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
                                  has_aux=has_aux)

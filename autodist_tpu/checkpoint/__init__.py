@@ -1,0 +1,15 @@
+"""Checkpointing — save/restore under original single-node names.
+
+Counterpart of reference ``autodist/checkpoint/`` (``saver.py``,
+``saved_model_builder.py``). The load-bearing property (reference
+``checkpoint/saver.py:47-61``, verified by ``tests/integration/cases/c0.py:130-138``)
+is preserved: checkpoints are written under the model's ORIGINAL parameter names as
+full unsharded logical arrays, whatever the distribution strategy — so a checkpoint
+written by a PartitionedPS run restores into an AllReduce run, a single-device run,
+or plain host numpy.
+"""
+
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.checkpoint.saved_model_builder import SavedModelBuilder
+
+__all__ = ["Saver", "SavedModelBuilder"]

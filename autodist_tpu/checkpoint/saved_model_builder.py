@@ -1,0 +1,57 @@
+"""SavedModel-equivalent export for serving.
+
+Counterpart of reference ``checkpoint/saved_model_builder.py:24-64`` (a
+SavedModelBuilder that exported the transformed graph's variables under original
+names for vanilla-TF serving). The TPU-native serving artifact is a directory with:
+
+- ``params.npz`` — full unsharded parameters under original names (via Saver),
+- ``model_config.json`` — user-provided model metadata (enough to rebuild the
+  apply function),
+- optionally ``apply.hlo`` — the StableHLO text of the jitted apply function, a
+  framework-independent serving graph (what a SavedModel's GraphDef was to TF).
+"""
+
+import json
+import os
+from typing import Any, Callable, Optional
+
+import jax
+
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.utils import logging
+
+
+class SavedModelBuilder:
+    def __init__(self, export_dir: str):
+        self._export_dir = export_dir
+        os.makedirs(export_dir, exist_ok=True)
+
+    def save(self, params: Any, model_config: Optional[dict] = None,
+             apply_fn: Optional[Callable] = None, example_args: tuple = ()) -> str:
+        saver = Saver(max_to_keep=1)
+        saver.save(params, os.path.join(self._export_dir, "params"), global_step=0)
+        # Rename to the stable serving name (no step suffix) and drop the Saver's
+        # latest-pointer state file, which would point at the renamed-away prefix.
+        for suffix in (".npz", ".json"):
+            src = os.path.join(self._export_dir, "params-0" + suffix)
+            dst = os.path.join(self._export_dir, "params" + suffix)
+            if os.path.exists(src):
+                os.replace(src, dst)
+        state_file = os.path.join(self._export_dir, "checkpoint")
+        if os.path.exists(state_file):
+            os.remove(state_file)
+
+        with open(os.path.join(self._export_dir, "model_config.json"), "w") as f:
+            json.dump(model_config or {}, f, indent=1, sort_keys=True)
+
+        if apply_fn is not None:
+            lowered = jax.jit(apply_fn).lower(params, *example_args)
+            with open(os.path.join(self._export_dir, "apply.hlo"), "w") as f:
+                f.write(lowered.as_text())
+
+        logging.info("Exported serving artifact to %s", self._export_dir)
+        return self._export_dir
+
+    @staticmethod
+    def load_params(export_dir: str):
+        return Saver().restore_params(os.path.join(export_dir, "params"))

@@ -1,0 +1,217 @@
+"""Saver: strategy-independent checkpoints under original parameter names.
+
+Reference parity (``autodist/checkpoint/saver.py``):
+
+- Saves under ORIGINAL single-node names whatever the strategy (``:47-61``): each
+  parameter is gathered to a full logical array first — the inverse of the
+  reference's ``SaveSliceInfo`` reassembly of partitioned variables
+  (``kernel/partitioner.py:251-347``).
+- Restoring reshards onto whatever mesh/strategy the reader uses (the reference
+  restored a checkpoint into differently-distributed runs or plain TF).
+- ``max_to_keep`` rotation and a ``checkpoint`` state file mirror ``tf.train.Saver``
+  semantics the reference inherited.
+
+Format: one ``<prefix>.npz`` holding ``{name: full ndarray}`` plus a JSON manifest
+(``<prefix>.json``) with names, shapes, dtypes, and the saved step. Optimizer state
+is saved under an ``__opt__/`` prefix, the step counter under ``__step__``.
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+PyTree = Any
+
+_OPT_PREFIX = "__opt__/"
+_EF_PREFIX = "__ef__/"
+_STEP_KEY = "__step__"
+_STATE_FILE = "checkpoint"  # directory-level latest-pointer, like TF's
+
+
+def _flatten_named(tree: PyTree) -> Dict[str, np.ndarray]:
+    """Flatten a pytree to {original-name: full host ndarray}.
+
+    ``jax.device_get`` on a sharded Array assembles the full logical value — the
+    TPU-native equivalent of reassembling partitioned shards via SaveSliceInfo.
+    """
+    from autodist_tpu.model_spec import _path_name
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[_path_name(path)] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def _nest(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Rebuild a nested dict from '/'-joined names (inverse of _flatten_named for
+    dict-based pytrees, which is what flax params are)."""
+    root: Dict[str, Any] = {}
+    for name, value in flat.items():
+        parts = name.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return root
+
+
+class Saver:
+    """Save/restore train state or bare params, strategy-independently."""
+
+    def __init__(self, max_to_keep: int = 5):
+        self._max_to_keep = max_to_keep
+        self._kept: List[str] = []
+
+    # ------------------------------------------------------------------- save
+    def save(self, state_or_params: PyTree, save_path: str,
+             global_step: Optional[int] = None) -> str:
+        """Write a checkpoint. Accepts a TrainState (params + opt state + step) or a
+        bare params pytree. Returns the checkpoint prefix."""
+        from autodist_tpu.runner import TrainState
+
+        flat: Dict[str, np.ndarray] = {}
+        if isinstance(state_or_params, TrainState):
+            flat.update(_flatten_named(state_or_params.params))
+            flat.update({_OPT_PREFIX + k: v for k, v in
+                         _flatten_named(state_or_params.opt_state).items()})
+            flat.update({_EF_PREFIX + k: v for k, v in
+                         _flatten_named(state_or_params.ef_state).items()})
+            step = int(np.asarray(jax.device_get(state_or_params.step)))
+        else:
+            flat.update(_flatten_named(state_or_params))
+            step = 0
+        # An explicit global_step overrides the state's counter for BOTH the file
+        # name and the stored step, so they can never disagree.
+        if global_step is not None:
+            step = global_step
+        flat[_STEP_KEY] = np.asarray(step)
+        prefix = f"{save_path}-{step}"
+
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+        tmp = prefix + ".npz.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, prefix + ".npz")  # atomic publish
+
+        manifest = {
+            "step": step,
+            "params": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                       for k, v in flat.items() if not k.startswith("__")},
+        }
+        with open(prefix + ".json", "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+
+        self._rotate(prefix)
+        self._update_state_file(save_path, prefix)  # after rotation: lists live files
+        logging.info("Saved checkpoint %s (step %d, %d tensors)",
+                     prefix, step, len(flat))
+        return prefix
+
+    def _update_state_file(self, save_path: str, prefix: str):
+        state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
+        with open(state_path, "w") as f:
+            json.dump({"latest": prefix, "all": list(self._kept)}, f)
+
+    def _rotate(self, prefix: str):
+        self._kept.append(prefix)
+        while len(self._kept) > self._max_to_keep:
+            victim = self._kept.pop(0)
+            for suffix in (".npz", ".json"):
+                try:
+                    os.remove(victim + suffix)
+                except OSError:
+                    pass
+
+    # ---------------------------------------------------------------- restore
+    @staticmethod
+    def latest_checkpoint(directory: str) -> Optional[str]:
+        state_path = os.path.join(directory, _STATE_FILE)
+        if not os.path.exists(state_path):
+            return None
+        with open(state_path) as f:
+            return json.load(f).get("latest")
+
+    def restore_params(self, prefix: str) -> Dict[str, Any]:
+        """Load the parameter tree as a nested host-numpy dict (original names)."""
+        flat = dict(np.load(prefix + ".npz"))
+        params = {k: v for k, v in flat.items() if not k.startswith("__")}
+        return _nest(params)
+
+    def restore(self, prefix: str, runner=None, params_template: PyTree = None):
+        """Restore a checkpoint.
+
+        With ``runner``: returns a fully-placed TrainState on the runner's mesh
+        (params + optimizer state + step), resharded per the runner's plan — this is
+        the cross-strategy restore path.
+        With only ``params_template``: returns a params pytree matching the
+        template's structure (for single-device / different-framework use).
+        """
+        flat = dict(np.load(prefix + ".npz"))
+        step = int(flat.pop(_STEP_KEY, np.asarray(0)))
+        params_flat = {k: v for k, v in flat.items()
+                       if not k.startswith("__")}
+        opt_flat = {k[len(_OPT_PREFIX):]: v for k, v in flat.items()
+                    if k.startswith(_OPT_PREFIX)}
+        ef_flat = {k[len(_EF_PREFIX):]: v for k, v in flat.items()
+                   if k.startswith(_EF_PREFIX)}
+
+        if runner is None:
+            if params_template is None:
+                return _nest(params_flat)
+            return _fill_template(params_template, params_flat)
+
+        # Rebuild state through the runner: init gives correctly-structured,
+        # correctly-sharded state; we then overwrite leaves from the checkpoint.
+        template_params = _fill_template_like_names(runner, params_flat)
+        state = runner.init(template_params)
+        if opt_flat:
+            opt_state = _fill_template(state.opt_state, opt_flat, strict=False)
+            o_sh = runner.plan.opt_sharding_tree(runner.mesh, opt_state)
+            opt_state = jax.device_put(opt_state, o_sh)
+        else:
+            opt_state = state.opt_state
+        if ef_flat:
+            ef_state = _fill_template(state.ef_state, ef_flat, strict=False)
+            ef_state = jax.device_put(
+                ef_state, jax.tree_util.tree_map(lambda l: l.sharding, state.ef_state))
+        else:
+            ef_state = state.ef_state
+        from autodist_tpu.runner import TrainState
+        return TrainState(step=np.asarray(step, np.int32), params=state.params,
+                          opt_state=opt_state, ef_state=ef_state)
+
+
+def _fill_template(template: PyTree, flat: Dict[str, np.ndarray], strict: bool = True):
+    """Replace template leaves by name; leaves missing from the checkpoint are kept
+    (strict=False) or are an error (strict=True)."""
+    from autodist_tpu.model_spec import _path_name
+
+    def fill(path, leaf):
+        name = _path_name(path)
+        if name in flat:
+            value = flat[name]
+            if tuple(value.shape) != tuple(getattr(leaf, "shape", value.shape)):
+                raise ValueError(f"Checkpoint shape mismatch for {name}: "
+                                 f"{value.shape} vs {leaf.shape}")
+            return value
+        if strict:
+            raise KeyError(f"Checkpoint missing parameter {name!r}")
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fill, template)
+
+
+def _fill_template_like_names(runner, params_flat):
+    """Build a params pytree for runner.init from checkpoint names using the
+    runner's recorded tree structure."""
+    spec = runner._model_spec
+    leaves = []
+    for name in spec.names:
+        if name not in params_flat:
+            raise KeyError(f"Checkpoint missing parameter {name!r}")
+        leaves.append(params_flat[name])
+    return spec.unflatten(leaves)

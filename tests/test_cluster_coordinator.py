@@ -1,0 +1,126 @@
+"""Cluster/Coordinator launch protocol — reference coordinator.py / cluster.py parity
+tested with local (loopback) worker addresses so no real SSH is needed."""
+
+import json
+import os
+import sys
+import time
+
+import jax.numpy as jnp
+
+from autodist_tpu import const
+from autodist_tpu.cluster import Cluster, is_local_address
+from autodist_tpu.coordinator import Coordinator
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+
+TWO_NODE = ResourceSpec(
+    "nodes: [{address: localhost, tpus: 4, chief: true}, {address: 127.0.0.1, tpus: 4}]")
+
+
+def _strategy():
+    model = ModelSpec({"w": jnp.zeros((4, 2))})
+    return AllReduce().build(model, TWO_NODE)
+
+
+def test_cluster_spec_deterministic_ids():
+    c = Cluster(TWO_NODE)
+    assert c.num_processes == 2
+    assert c.cluster_spec["processes"][0]["address"] == "localhost"  # chief first
+    assert c.process_id_of("127.0.0.1") == 1
+    assert c.cluster_spec["coordinator"].startswith("localhost:")
+
+
+def test_cluster_start_writes_spec_file(tmp_path, monkeypatch):
+    monkeypatch.setattr(const, "DEFAULT_WORKING_DIR", str(tmp_path))
+    c = Cluster(TWO_NODE)
+    c.start()
+    with open(tmp_path / "cluster_spec.json") as f:
+        spec = json.load(f)
+    assert spec == c.cluster_spec
+
+
+def test_remote_exec_local_runs_with_env(tmp_path):
+    c = Cluster(TWO_NODE)
+    out = tmp_path / "envdump"
+    proc = c.remote_exec(
+        [sys.executable, "-c",
+         f"import os; open({str(out)!r}, 'w').write(os.environ.get('AUTODIST_WORKER',''))"],
+        "localhost", env={"AUTODIST_WORKER": "127.0.0.1"})
+    assert proc.wait() == 0
+    assert out.read_text() == "127.0.0.1"
+
+
+def test_remote_file_write_and_copy_local(tmp_path):
+    c = Cluster(TWO_NODE)
+    target = tmp_path / "sub" / "f.txt"
+    c.remote_file_write(str(target), "hello", "localhost")
+    assert target.read_text() == "hello"
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"abc")
+    c.remote_copy(str(src), str(tmp_path / "dest"), "127.0.0.1")
+    assert (tmp_path / "dest" / "src.bin").read_bytes() == b"abc"
+
+
+def test_coordinator_launches_worker_with_role_env(tmp_path):
+    """The worker re-runs 'the user script' with AUTODIST_WORKER/STRATEGY_ID/
+    process-id env set (reference coordinator.py:66-90)."""
+    strategy = _strategy()
+    cluster = Cluster(TWO_NODE)
+    out = tmp_path / "worker_env.json"
+    script = tmp_path / "user_script.py"
+    script.write_text(
+        "import json, os\n"
+        "keys = ['AUTODIST_WORKER', 'AUTODIST_STRATEGY_ID',\n"
+        "        'AUTODIST_COORDINATOR_ADDR', 'AUTODIST_NUM_PROCESSES',\n"
+        "        'AUTODIST_PROCESS_ID']\n"
+        f"json.dump({{k: os.environ.get(k) for k in keys}}, open({str(out)!r}, 'w'))\n")
+    coord = Coordinator(strategy, cluster, argv=[str(script)])
+    coord.launch_clients()
+    coord.join()
+    env = json.loads(out.read_text())
+    assert env["AUTODIST_WORKER"] == "127.0.0.1"
+    assert env["AUTODIST_STRATEGY_ID"] == strategy.id
+    assert env["AUTODIST_NUM_PROCESSES"] == "2"
+    assert env["AUTODIST_PROCESS_ID"] == "1"
+    assert env["AUTODIST_COORDINATOR_ADDR"].startswith("localhost:")
+    # strategy file exists where the worker will load it
+    assert os.path.exists(os.path.join(const.DEFAULT_SERIALIZATION_DIR, strategy.id))
+
+
+def test_watchdog_fires_on_nonzero_worker_exit(tmp_path):
+    strategy = _strategy()
+    cluster = Cluster(TWO_NODE)
+    script = tmp_path / "bad_script.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    failures = []
+
+    class TestCoordinator(Coordinator):
+        def _on_worker_failure(self, address, code):
+            failures.append((address, code))
+
+    coord = TestCoordinator(strategy, cluster, argv=[str(script)])
+    coord.launch_clients()
+    deadline = time.time() + 10
+    while not failures and time.time() < deadline:
+        time.sleep(0.05)
+    assert failures == [("127.0.0.1", 3)]
+
+
+def test_cluster_terminate_kills_processes():
+    c = Cluster(TWO_NODE)
+    proc = c.remote_exec([sys.executable, "-c", "import time; time.sleep(60)"],
+                         "localhost")
+    assert proc.poll() is None
+    c.terminate()
+    deadline = time.time() + 5
+    while proc.poll() is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert proc.poll() is not None
+
+
+def test_is_local_address():
+    assert is_local_address("localhost")
+    assert is_local_address("127.0.0.1")
+    assert not is_local_address("10.0.0.5")
