@@ -80,8 +80,14 @@ class MultiHeadAttention(nn.Module):
             from autodist_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
         elif cfg.attention_impl == "ring":
-            from autodist_tpu.parallel.ring_attention import ring_attention
-            ctx = ring_attention(q, k, v, causal=True)
+            # Requires the whole step to run inside a shard_map binding the `seq`
+            # axis with globally-offset positions — the sequence-parallel runner
+            # path. Standalone ring attention is available today via
+            # autodist_tpu.parallel.ring_attention / make_ring_attention_fn.
+            raise NotImplementedError(
+                "attention_impl='ring' is only valid inside a sequence-parallel "
+                "shard_map; use autodist_tpu.parallel.ring_attention directly, or "
+                "'flash'/'dot' for single-shard sequences")
         else:  # "dot" (config validates the value set)
             ctx = dot_product_attention(q, k, v, mask, cfg.dtype)
 

@@ -1,0 +1,6 @@
+"""Hot-op kernels: pallas TPU kernels with pure-JAX blockwise fallbacks."""
+
+from autodist_tpu.ops.blockwise_attention import blockwise_attention
+from autodist_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["blockwise_attention", "flash_attention"]

@@ -1,0 +1,114 @@
+"""Blockwise (memory-efficient) attention in pure JAX.
+
+Online-softmax attention scanned over key/value blocks: peak memory is
+O(L * block) instead of O(L^2), fully differentiable (XLA differentiates the
+scan), and runs on any backend. This is the reference semantics for the pallas
+flash kernel, the backward path of :func:`flash_attention`, and the per-step local
+operation of ring attention (the online-softmax merge is exactly the ring
+accumulation rule).
+
+The reference framework has no attention machinery at all (SURVEY.md §5.7); this
+is part of the long-context capability the TPU build adds as first-class.
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _merge(acc, m, l, scores, v_blk):
+    """One online-softmax update (all f32).
+
+    acc: [..., q, d] unnormalized output; m: [..., q] running max;
+    l: [..., q] running denominator; scores: [..., q, k]; v_blk: [..., k, d].
+    """
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    # Zero fully-masked entries explicitly: when a whole row is masked both scores
+    # and m_new sit at NEG_INF and exp(0)=1 would poison the denominator.
+    p = jnp.where(scores <= NEG_INF * 0.5, 0.0, jnp.exp(scores - m_new[..., None]))
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_blk)
+    return acc_new, m_new, l_new
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True, block_size: int = 256,
+                        q_offset: int = 0, k_offset: int = 0) -> jax.Array:
+    """Memory-efficient attention. q/k/v: [B, L, H, D] (L may differ for q vs k/v).
+
+    ``q_offset``/``k_offset`` are the global positions of the first query/key —
+    ring attention passes the ring-shifted key offset so causal masking stays
+    globally correct.
+    """
+    out, _, _ = _blockwise_inner(q, k, v, causal, block_size, q_offset, k_offset,
+                                 init_carry=None)
+    return out
+
+
+def blockwise_attention_with_carry(q, k, v, carry, *, causal=True, block_size=256,
+                                   q_offset=0, k_offset=0):
+    """Ring-attention building block: same scan, but accepting and returning the
+    (acc, m, l) carry so partial results merge across ring steps. Returns
+    ((acc, m, l)); normalize with :func:`finalize` after the last step."""
+    _, (acc, m, l), _ = _blockwise_inner(q, k, v, causal, block_size, q_offset,
+                                         k_offset, init_carry=carry,
+                                         return_carry=True)
+    return acc, m, l
+
+
+def finalize(acc, m, l):
+    """Normalize an online-softmax carry into the attention output."""
+    safe_l = jnp.maximum(l, 1e-30)
+    return acc / safe_l[..., None]
+
+
+def _blockwise_inner(q, k, v, causal, block_size, q_offset, k_offset, init_carry,
+                     return_carry: bool = False):
+    orig_dtype = q.dtype
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+
+    # [B, H, L, D] in f32 for the accumulation.
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32) * scale
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+
+    block = min(block_size, lk)
+    n_blocks = (lk + block - 1) // block
+    pad = n_blocks * block - lk
+    if pad:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+    if init_carry is None:
+        acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+        m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, lq), jnp.float32)
+    else:
+        acc0, m0, l0 = init_carry
+
+    q_pos = q_offset + jnp.arange(lq)
+
+    def body(carry, j):
+        acc, m, l = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kt, j * block, block, axis=2)
+        v_blk = jax.lax.dynamic_slice_in_dim(vt, j * block, block, axis=2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, k_blk)
+        k_pos = k_offset + j * block + jnp.arange(block)
+        invalid = k_pos >= (k_offset + lk)          # padding keys
+        if causal:
+            invalid = invalid[None, :] | (k_pos[None, :] > q_pos[:, None])
+            scores = jnp.where(invalid[None, None], NEG_INF, scores)
+        else:
+            scores = jnp.where(invalid[None, None, None, :], NEG_INF, scores)
+        return _merge(acc, m, l, scores, v_blk), None
+
+    (acc, m, l), _ = jax.lax.scan(body, (acc0, m0, l0), jnp.arange(n_blocks))
+
+    if return_carry:
+        return None, (acc, m, l), None
+    out = finalize(acc, m, l)                       # [B, H, Lq, D]
+    return out.transpose(0, 2, 1, 3).astype(orig_dtype), None, None

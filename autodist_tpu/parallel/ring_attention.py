@@ -1,0 +1,82 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context capability beyond the reference (which has no sequence parallelism,
+SURVEY.md §5.7): the sequence dimension is sharded over the ``seq`` axis; each
+device holds its local Q/K/V shard, and K/V shards rotate around the ring via
+``jax.lax.ppermute`` while every device accumulates its queries' attention with the
+online-softmax merge (:mod:`autodist_tpu.ops.blockwise_attention`). After
+``seq_size`` steps every query has attended to every key, with peak activation
+memory O(L/seq_size) per device and communication overlapping compute the XLA way
+(each ppermute is independent of the current step's FLOPs).
+
+Causality is preserved globally: each ring step knows the global offset of the K/V
+shard it currently holds and masks accordingly.
+"""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.ops.blockwise_attention import (blockwise_attention_with_carry as _bw_carry, finalize as _bw_finalize)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   causal: bool = True, axis_name: str = const.MESH_AXIS_SEQ,
+                   block_size: int = 256) -> jax.Array:
+    """Attention with K/V rotating around the ``axis_name`` ring.
+
+    Must run inside a ``shard_map`` (or any SPMD context) where ``axis_name`` is a
+    mesh axis and the inputs' sequence dimension (axis 1 of [B, L_local, H, D]) is
+    the local shard of the global sequence in ring order: device r holds global
+    positions [r*L_local, (r+1)*L_local).
+    """
+    ring_size = jax.lax.axis_size(axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    _, l_local, _, _ = q.shape
+
+    q_offset = my_index * l_local
+
+    acc = None
+    k_cur, v_cur = k, v
+    # The shard we hold at step s originated at device (my_index - s) mod ring.
+    for step in range(ring_size):
+        src = (my_index - step) % ring_size
+        k_offset = src * l_local
+
+        def attend(operands):
+            q_, k_, v_, carry = operands
+            return _bw_carry(q_, k_, v_, carry, causal=causal,
+                             block_size=block_size, q_offset=q_offset,
+                             k_offset=k_offset)
+
+        if acc is None:
+            acc = attend((q, k_cur, v_cur, None))
+        elif causal:
+            # Shards originating strictly after ours are fully future under the
+            # causal mask — skip their FLOPs entirely (the merge is the identity).
+            acc = jax.lax.cond(src <= my_index, attend,
+                               lambda operands: operands[3],
+                               (q, k_cur, v_cur, acc))
+        else:
+            acc = attend((q, k_cur, v_cur, acc))
+        if step != ring_size - 1:
+            perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    out = _bw_finalize(*acc)                         # [B, H, Lq, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
+                           block_size: int = 256):
+    """Wrap :func:`ring_attention` in a shard_map over (data, seq): batch shards on
+    the data axes, sequence on ``seq``, heads/depth replicated."""
+    spec = P((const.MESH_AXIS_DATA, const.MESH_AXIS_REDUCE),
+             const.MESH_AXIS_SEQ, None, None)
+
+    def fn(q, k, v):
+        return ring_attention(q, k, v, causal=causal, block_size=block_size)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
