@@ -52,6 +52,8 @@ _ENV_DEFAULTS = {
     "AUTODIST_COORDINATOR_ADDR": "",       # "ip:port" of jax.distributed coordinator
     "AUTODIST_NUM_PROCESSES": 1,
     "AUTODIST_PROCESS_ID": 0,
+    # Dump jaxpr/StableHLO per build stage (reference graph visualizer parity).
+    "AUTODIST_DUMP_GRAPHS": False,
 }
 
 class ENV(enum.Enum):
@@ -69,6 +71,7 @@ class ENV(enum.Enum):
     AUTODIST_COORDINATOR_ADDR = "AUTODIST_COORDINATOR_ADDR"
     AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
     AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
+    AUTODIST_DUMP_GRAPHS = "AUTODIST_DUMP_GRAPHS"
 
     @property
     def val(self):

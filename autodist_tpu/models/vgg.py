@@ -38,3 +38,19 @@ class VGG16(nn.Module):
 def make_loss_fn(model: VGG16) -> Callable:
     from autodist_tpu.models.common import make_classification_loss_fn
     return make_classification_loss_fn(model)
+
+
+def init_params(model: VGG16, rng=None, image_size: int = 224):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    images = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
+    return model.init(rng, images)["params"]
+
+
+def synthetic_batch(num_classes: int, batch_size: int, image_size: int = 224,
+                    seed: int = 0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return {
+        "images": rng.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "labels": rng.randint(0, num_classes, size=(batch_size,)).astype(np.int32),
+    }

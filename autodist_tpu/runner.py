@@ -152,13 +152,31 @@ class DistributedRunner:
         """One synchronized training step. Returns (new_state, fetches)."""
         if self._state_shardings is None:
             raise RuntimeError("Call init(params) before run()")
-        if self._step_fn is None:
+        first_build = self._step_fn is None
+        if first_build:
             self._build_step()
+        sharded = self.shard_batch(batch)
+        if first_build:
+            self._maybe_dump_graphs(state, sharded)
         with self.mesh:
-            new_state, (loss, aux) = self._step_fn(state, self.shard_batch(batch))
+            new_state, (loss, aux) = self._step_fn(state, sharded)
         if self._has_aux:
             return new_state, (loss, aux)
         return new_state, loss
+
+    def _maybe_dump_graphs(self, state: TrainState, sharded_batch: PyTree):
+        """Stage snapshots (reference dumped the graph at each transform stage,
+        graph_transformer.py:62-90): 0-original = the user's loss fn, 1-distributed
+        = the sharded train step. ``sharded_batch`` is already on-device."""
+        from autodist_tpu import const
+        if not const.ENV.AUTODIST_DUMP_GRAPHS.val:
+            return
+        from autodist_tpu.utils import tracing
+        with self.mesh:
+            tracing.dump_stage("train_step", "0-original", self._loss_fn,
+                               state.params, sharded_batch)
+            tracing.dump_stage("train_step", "1-distributed",
+                               lambda s, b: self._step_fn(s, b), state, sharded_batch)
 
     # Convenience parity alias: session.run(...)
     __call__ = run

@@ -1,0 +1,74 @@
+"""Throughput instrumentation.
+
+Counterparts of the reference's benchmark-side observability (SURVEY.md §5.1):
+``TimeHistory`` (``examples/benchmark/imagenet.py:84-133``, examples/sec per log
+period + run average) and ``ExamplesPerSecondHook``
+(``examples/benchmark/utils/logs/hooks.py:28-130``). These live in the framework
+here (the reference kept them in examples) so every example/benchmark shares one
+implementation.
+"""
+
+import time
+from typing import List, Optional
+
+from autodist_tpu.utils import logging
+
+
+class ThroughputMeter:
+    """examples/sec (or tokens/sec) per log period plus a run average."""
+
+    def __init__(self, batch_size: int, log_every: int = 100,
+                 unit: str = "examples", warmup_steps: int = 1):
+        self._batch_size = batch_size
+        self._log_every = log_every
+        self._unit = unit
+        self._warmup = warmup_steps
+        self._step = 0
+        now = time.perf_counter()
+        # warmup_steps=0 means "count from construction"; otherwise these restart
+        # when the last warmup step lands.
+        self._period_start: float = now
+        self._run_start: float = now
+        self._run_steps = 0
+        self.history: List[float] = []
+
+    def step(self, sync=None) -> Optional[float]:
+        """Record one completed step; returns the period rate when a period ends.
+
+        Pass the step's fetched value (e.g. the loss array) as ``sync``: dispatch is
+        asynchronous, so at period boundaries the meter forces a device->host read
+        of it before taking the clock — otherwise rates measure dispatch, not
+        compute."""
+        self._step += 1
+        at_boundary = (self._step > self._warmup
+                       and (self._run_steps + 1) % self._log_every == 0)
+        if (at_boundary or self._step == self._warmup) and sync is not None:
+            try:
+                import jax
+                jax.device_get(sync)
+            except Exception:
+                pass
+        now = time.perf_counter()
+        if self._step <= self._warmup:
+            # Exclude compile/warmup from rates (reference TimeHistory did the same
+            # by starting timers on_batch_begin after the first epoch).
+            self._period_start = now
+            self._run_start = now
+            self._run_steps = 0
+            return None
+        self._run_steps += 1
+        if self._run_steps % self._log_every == 0:
+            rate = self._log_every * self._batch_size / (now - self._period_start)
+            self.history.append(rate)
+            logging.info("step %d: %.1f %s/sec", self._step, rate, self._unit)
+            self._period_start = now
+            return rate
+        return None
+
+    @property
+    def average(self) -> Optional[float]:
+        """Run-average rate excluding warmup (reference logged the same)."""
+        if not self._run_steps:
+            return None
+        elapsed = time.perf_counter() - self._run_start
+        return self._run_steps * self._batch_size / elapsed

@@ -1,0 +1,127 @@
+"""Aux subsystems: throughput meter, tracing/graph dumps, example smoke runs."""
+
+import glob
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist, const
+from autodist_tpu.strategy import AllReduce
+from autodist_tpu.utils.metrics import ThroughputMeter
+from autodist_tpu.utils import tracing
+
+
+def test_throughput_meter_periods_and_average():
+    meter = ThroughputMeter(batch_size=10, log_every=2, warmup_steps=1)
+    for _ in range(5):  # 1 warmup + 4 counted
+        meter.step()
+        time.sleep(0.01)
+    assert len(meter.history) == 2          # two completed periods of 2 steps
+    assert meter.average is not None
+    assert 10 < meter.average < 10_000      # ~10 examples / ~0.01s
+
+def test_throughput_meter_excludes_warmup():
+    meter = ThroughputMeter(batch_size=1, log_every=100, warmup_steps=2)
+    meter.step()
+    time.sleep(0.2)                         # slow compile step
+    meter.step()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        meter.step()
+        time.sleep(0.001)
+    avg = meter.average
+    # Average must reflect the fast steps only (~1000/s), not the 0.2s warmup.
+    assert avg > 100
+
+
+def test_dump_stage_writes_jaxpr_and_hlo(tmp_path):
+    def f(x):
+        return jnp.sin(x) * 2
+
+    base = tracing.dump_stage("t", "0-original", f, jnp.ones((4,)),
+                              dump_dir=str(tmp_path))
+    assert base is not None
+    assert os.path.exists(base + ".jaxpr.txt")
+    assert os.path.exists(base + ".stablehlo.txt")
+    assert "stablehlo" in open(base + ".stablehlo.txt").read()
+
+
+def test_trace_writes_profile(tmp_path):
+    import jax
+    with tracing.trace("unit", trace_dir=str(tmp_path / "tr")) as d:
+        _ = jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+    # jax profiler writes plugins/profile/<ts>/*.pb files
+    found = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in found)
+
+
+def test_runner_graph_dump_flag(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTODIST_DUMP_GRAPHS", "1")
+    monkeypatch.setattr(const, "DEFAULT_GRAPH_DUMP_DIR", str(tmp_path))
+    ad = AutoDist(strategy_builder=AllReduce())
+    params = {"w": jnp.zeros(())}
+    batch = {"x": np.ones(8, np.float32), "y": np.ones(8, np.float32)}
+
+    def loss(p, b):
+        return jnp.mean((b["y"] - b["x"] * p["w"]) ** 2)
+
+    step = ad.function(loss, params, optax.sgd(0.1), example_batch=batch)
+    step(batch)
+    dumped = glob.glob(str(tmp_path / "train_step" / "*"))
+    names = {os.path.basename(p) for p in dumped}
+    assert "0-original.jaxpr.txt" in names
+    assert "1-distributed.stablehlo.txt" in names
+
+
+def test_image_classifier_example():
+    import examples.image_classifier as ic
+    losses = ic.main(epochs=2, batch_size=64)
+    assert losses[-1] < losses[0]
+
+
+def test_sentiment_example_routes_embedding_to_ps():
+    import examples.sentiment_classifier as sc
+    losses = sc.main(steps=12)
+    assert losses[-1] < losses[0]
+
+
+def test_lm1b_example_runs():
+    import examples.lm1b.lm1b_train as lm
+    avg = lm.main(["--steps", "4", "--batch_size", "8", "--seq_len", "16",
+                   "--d_model", "32", "--n_layers", "1", "--vocab", "128",
+                   "--log_every", "2"])
+    assert avg is None or avg > 0
+
+
+def test_imagenet_benchmark_tiny():
+    import examples.benchmark.imagenet as im
+    avg = im.main(["--model", "resnet50", "--strategy", "AllReduce",
+                   "--steps", "3", "--batch_size", "8", "--image_size", "64",
+                   "--log_every", "2"])
+    assert avg is None or avg >= 0
+
+
+def test_ncf_benchmark_tiny():
+    import examples.benchmark.ncf as n
+    avg = n.main(["--steps", "3", "--batch_size", "64", "--log_every", "2"])
+    assert avg is None or avg >= 0
+
+
+def test_bert_benchmark_tiny():
+    import examples.benchmark.bert as b
+    avg = b.main(["--size", "tiny", "--steps", "3", "--batch_size", "8",
+                  "--seq_len", "16", "--log_every", "2"])
+    assert avg is None or avg >= 0
+
+
+def test_throughput_meter_zero_warmup():
+    meter = ThroughputMeter(batch_size=4, log_every=2, warmup_steps=0)
+    for _ in range(4):
+        meter.step()
+        time.sleep(0.001)
+    assert len(meter.history) == 2
+    assert meter.average > 0
