@@ -147,15 +147,33 @@ class AutoDist:
     def create_distributed_session(self, loss_fn: Callable, params: Any, optimizer,
                                    example_batch: Any = None,
                                    sparse_names: Optional[Sequence[str]] = None,
-                                   has_aux: bool = False) -> DistributedRunner:
+                                   has_aux: bool = False,
+                                   num_workers: Optional[int] = None) -> DistributedRunner:
         """Compile the strategy for this model and return the runner
-        (reference autodist.py:191-198 returned the wrapped session)."""
+        (reference autodist.py:191-198 returned the wrapped session).
+
+        Strategies requesting a non-synchronous PS regime (``sync=False`` or
+        ``staleness>0``) return the host-driven :class:`AsyncPSRunner` instead of the
+        SPMD runner — the reference switched regimes inside PSSynchronizer
+        (``ps_synchronizer.py:335-458``); here the regime selects the runner.
+        ``num_workers`` sizes the async worker pool. The default is 1 (the drop-in
+        ``run()`` path drives a single worker; the staleness gate is in-process, so
+        sizing it by cluster nodes would gate against phantom workers that never
+        step) — pass it explicitly when driving multiple worker handles.
+        """
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
         strategy = self.build_strategy(model_spec)
         self._setup(strategy)
         compiled = self._compile(model_spec)
+        from autodist_tpu.parallel.plan import ShardingPlan
+        plan = ShardingPlan.from_strategy(compiled, model_spec)
+        if plan.is_async:
+            from autodist_tpu.parallel.staleness import AsyncPSRunner
+            workers = num_workers or 1
+            return AsyncPSRunner(compiled, model_spec, loss_fn, optimizer,
+                                 has_aux=has_aux, num_workers=workers, plan=plan)
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
-                                 has_aux=has_aux)
+                                 has_aux=has_aux, plan=plan)
 
     def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
         if sparse_names is not None:
@@ -170,9 +188,15 @@ class AutoDist:
                  has_aux: bool = False) -> Callable:
         """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
         internally (reference autodist.py:252-289 cached a built runner the same
-        way: first call builds, later calls reuse)."""
+        way: first call builds, later calls reuse).
+
+        Async strategies get ``num_workers=1``: the ``step`` closure is one worker's
+        loop (the reference ran one such loop per process, other workers being other
+        processes); gating it against in-process phantom workers that never step
+        would deadlock after ``staleness`` steps."""
         runner = self.create_distributed_session(
-            loss_fn, params, optimizer, example_batch, sparse_names, has_aux)
+            loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
+            num_workers=1)
         state = runner.init(params)
 
         def step(batch):

@@ -142,6 +142,18 @@ class ShardingPlan:
         return any(p.compressor != COMP_NONE for p in self.params.values())
 
     @property
+    def is_async(self) -> bool:
+        """True when any PS node requests a non-synchronous regime (sync=False or
+        staleness>0) — these compile to the host-driven dispatch loop
+        (parallel/staleness.py), not to one SPMD program."""
+        return any(p.sync == SYNC_PS and (not p.synchronous or p.staleness > 0)
+                   for p in self.params.values())
+
+    @property
+    def max_staleness(self) -> int:
+        return max((p.staleness for p in self.params.values()), default=0)
+
+    @property
     def all_params_replicated(self) -> bool:
         return all(p.pspec == P() for p in self.params.values())
 

@@ -1,0 +1,265 @@
+"""Host-driven async / bounded-staleness execution.
+
+The reference's PS synchronizer supported three update regimes
+(``kernel/synchronization/ps_synchronizer.py``): synchronous (ConditionalAccumulator
+taking ``num_workers`` gradients, chief-token FIFO queue of size 1, ``:335-385``),
+bounded staleness (token queues of size ``staleness`` letting a fast worker run ahead,
+``:387-458``), and fully async (``sync=False`` — each worker's gradient applied as it
+arrives). SPMD collectives are inherently synchronous, so the two non-sync regimes
+cannot live inside one XLA program; they are re-designed here as a **host-driven
+dispatch loop** (SURVEY.md §7.3 hard part #1):
+
+- :class:`ParameterService` owns the train state (on the mesh, sharded per the plan)
+  and applies one worker's gradient at a time through a jitted update — the PS apply.
+- :class:`StalenessController` reifies the reference's token queues as a condition
+  variable over per-worker completed-step counts: a worker may *start* a step only
+  while ``its_steps - min(all_steps) < staleness`` (so it can finish exactly
+  ``staleness`` steps ahead before blocking — the behavior the reference asserts in
+  ``tests/integration/cases/c9.py:92-126``). ``staleness == 0`` with ``sync=False``
+  is fully async (unbounded).
+- :class:`AsyncPSRunner` gives each logical worker (reference: one process per node,
+  ``coordinator.py:66-90``) a handle whose ``step(batch)`` reads the *current* —
+  possibly newer than its last read, never blocked on other workers' compute —
+  parameters, computes gradients, and pushes them. jax.Array immutability gives
+  stale-snapshot semantics for free: a worker holding an old reference keeps a
+  consistent old version (state donation is disabled for exactly this reason).
+"""
+
+import math
+import threading
+from typing import Any, Optional
+
+import jax
+
+from autodist_tpu.runner import DistributedRunner, TrainState
+from autodist_tpu.utils import logging
+
+PyTree = Any
+
+
+class StalenessTimeout(TimeoutError):
+    """A gated worker step did not become runnable within the timeout."""
+
+
+class StalenessController:
+    """Bounded-staleness gate over per-worker completed-step counts.
+
+    Token-queue parity (reference ``ps_synchronizer.py:387-458``): with bound ``s`` a
+    worker can complete exactly ``s`` more steps than the slowest worker before its
+    next ``start_step`` blocks. ``bound=None`` means unbounded (fully async).
+    """
+
+    def __init__(self, num_workers: int, staleness: int = 0):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        self._bound = staleness if staleness > 0 else math.inf
+        self._steps = [0] * num_workers
+        self._cond = threading.Condition()
+
+    @property
+    def steps(self):
+        with self._cond:
+            return list(self._steps)
+
+    def _runnable(self, worker_id: int) -> bool:
+        return self._steps[worker_id] - min(self._steps) < self._bound
+
+    def start_step(self, worker_id: int, timeout: Optional[float] = None):
+        """Block until the worker is within the staleness bound.
+
+        Raises :class:`StalenessTimeout` if the bound does not open in ``timeout``
+        seconds (the reference's queue dequeue blocked forever; a timeout keeps the
+        failure mode debuggable).
+        """
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._runnable(worker_id), timeout):
+                raise StalenessTimeout(
+                    f"worker {worker_id} at step {self._steps[worker_id]} still "
+                    f">= {self._bound} ahead of the slowest worker after {timeout}s")
+
+    def finish_step(self, worker_id: int):
+        with self._cond:
+            self._steps[worker_id] += 1
+            self._cond.notify_all()
+
+
+class ParameterService:
+    """The PS: owns the train state, serializes gradient application.
+
+    Counterpart of the reference's PS-device accumulators + update ops
+    (``ps_synchronizer.py:556-633``), with the accumulator replaced by one-at-a-time
+    application (async semantics: no cross-worker averaging).
+    """
+
+    def __init__(self, state: TrainState, apply_fn):
+        self._state = state
+        self._apply_fn = apply_fn
+        self._lock = threading.Lock()
+        self._version = 0
+
+    def reset(self, state: TrainState):
+        """Replace the state (checkpoint restore). Version restarts at 0."""
+        with self._lock:
+            self._state = state
+            self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def state(self) -> TrainState:
+        return self._state
+
+    def read(self):
+        """Consistent snapshot of (params, ef_state, version) under one lock hold.
+        jax.Arrays are immutable, so the returned references stay consistent however
+        far the service advances afterwards."""
+        with self._lock:
+            return self._state.params, self._state.ef_state, self._version
+
+    def apply(self, grads: PyTree) -> int:
+        """Apply one worker's gradients; returns the new version."""
+        with self._lock:
+            self._state = self._apply_fn(self._state, grads)
+            self._version += 1
+            return self._version
+
+
+class AsyncWorker:
+    """One logical worker's handle (reference: one re-executed user script per node)."""
+
+    def __init__(self, runner: "AsyncPSRunner", worker_id: int):
+        self._runner = runner
+        self.worker_id = worker_id
+        self.steps_completed = 0
+        self.last_version_read = -1
+
+    def step(self, batch: PyTree, timeout: Optional[float] = None):
+        """One gated async step: wait for the staleness bound, pull current params,
+        compute local gradients, push to the PS. Returns the local loss (or
+        ``(loss, aux)`` when the runner was built with ``has_aux``)."""
+        r = self._runner
+        r.controller.start_step(self.worker_id, timeout)
+        params, ef_state, version = r.service.read()
+        self.last_version_read = version
+        sharded = r.shard_batch(batch)
+        with r.mesh:
+            grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
+            r.service.apply(grads)
+        r.controller.finish_step(self.worker_id)
+        self.steps_completed += 1
+        if r.has_aux:
+            return loss, aux
+        return loss
+
+
+class AsyncPSRunner(DistributedRunner):
+    """Async / bounded-staleness variant of the runner.
+
+    Selected when the compiled strategy requests a non-synchronous PS regime
+    (``sync=False`` or ``staleness>0`` on any PSSynchronizer node). The ``run``
+    interface stays drop-in with :class:`DistributedRunner` — the state argument is
+    accepted but the service's internal state is authoritative — so
+    ``AutoDist.function`` works unchanged; multi-worker tests drive
+    :meth:`worker` handles directly.
+    """
+
+    # Default gate timeout for the drop-in run() path: converts a mis-sized worker
+    # pool (workers that never step) from a silent hang into a diagnosable error.
+    DEFAULT_STEP_TIMEOUT = 600.0
+
+    def __init__(self, compiled_strategy, model_spec, loss_fn, optimizer,
+                 mesh=None, has_aux: bool = False, num_workers: int = 1,
+                 donate_state: bool = False, plan=None):
+        # Never donate: stale workers hold references to old param buffers.
+        super().__init__(compiled_strategy, model_spec, loss_fn, optimizer,
+                         mesh=mesh, has_aux=has_aux, donate_state=False, plan=plan)
+        if self.plan.has_compression:
+            raise NotImplementedError(
+                "Gradient compression is not supported in the async PS mode")
+        self.num_workers = max(1, num_workers)
+        self.staleness = self.plan.max_staleness
+        self.controller = StalenessController(self.num_workers, self.staleness)
+        self.service: Optional[ParameterService] = None
+        # The un-jitted closure re-dispatches op-by-op; async steps call it outside
+        # the (jitted) sync step_fn, so compile it here.
+        self._jit_grad_fn = jax.jit(self._grad_fn)
+        self._workers = {i: AsyncWorker(self, i) for i in range(self.num_workers)}
+        logging.info("AsyncPSRunner: %d worker(s), staleness=%s",
+                     self.num_workers, self.staleness or "unbounded")
+
+    @property
+    def grad_fn(self):
+        return self._jit_grad_fn
+
+    @property
+    def has_aux(self) -> bool:
+        return self._has_aux
+
+    # ------------------------------------------------------------------- state
+    def init(self, params: PyTree, rng=None) -> TrainState:
+        state = super().init(params, rng)
+        apply_fn = jax.jit(
+            self._apply, in_shardings=(self._state_shardings, None),
+            out_shardings=self._state_shardings)
+        self.service = ParameterService(state, self._locked_apply(apply_fn))
+        return state
+
+    def _apply(self, state: TrainState, grads: PyTree) -> TrainState:
+        import optax
+        updates, opt_state = self._optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state, ef_state=state.ef_state)
+
+    def _locked_apply(self, apply_fn):
+        def run(state, grads):
+            with self.mesh:
+                return apply_fn(state, grads)
+        return run
+
+    # ------------------------------------------------------------------ workers
+    def worker(self, worker_id: int) -> AsyncWorker:
+        if self.service is None:
+            raise RuntimeError("Call init(params) before creating workers")
+        if not 0 <= worker_id < self.num_workers:
+            raise ValueError(f"worker_id {worker_id} out of range [0, {self.num_workers})")
+        return self._workers[worker_id]
+
+    def restore(self, state: TrainState):
+        """Adopt a (checkpoint-restored) state as the service's."""
+        if self.service is None:
+            raise RuntimeError("Call init(params) before restore()")
+        place = jax.jit(lambda s: s, out_shardings=self._state_shardings)
+        with self.mesh:
+            self.service.reset(place(state))
+
+    # --------------------------------------------------------------------- run
+    def run(self, state, batch: PyTree = None, worker_id: int = 0):
+        """Drop-in step: one async step on ``worker_id``; returns
+        ``(current_state, fetches)`` like the synchronous runner.
+
+        The PS owns the state in the async regimes, so the passed state is normally
+        the service's own (as returned by the previous ``run``) and is ignored. A
+        *foreign* state before the first applied update is a checkpoint restore
+        (the ``init → run(restored_state, ...)`` pattern) and re-seeds the service;
+        a foreign state later is ambiguous — other workers may have advanced the
+        service past the caller's snapshot — and raises."""
+        if batch is None:
+            state, batch = None, state
+        if state is not None and self.service is not None \
+                and state is not self.service.state:
+            if self.service.version == 0:
+                self.restore(state)
+            else:
+                raise RuntimeError(
+                    "AsyncPSRunner.run was handed a state that is not the service's "
+                    "current state after updates were already applied; use "
+                    "restore(state) to adopt a checkpoint explicitly")
+        fetched = self.worker(worker_id).step(batch, timeout=self.DEFAULT_STEP_TIMEOUT)
+        return self.service.state, fetched
+
+    __call__ = run

@@ -54,13 +54,14 @@ class DistributedRunner:
 
     def __init__(self, compiled_strategy, model_spec: ModelSpec, loss_fn: Callable,
                  optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
-                 donate_state: bool = True):
+                 donate_state: bool = True, plan: Optional[ShardingPlan] = None):
         self._model_spec = model_spec
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._has_aux = has_aux
         self._donate = donate_state
-        self.plan = ShardingPlan.from_strategy(compiled_strategy, model_spec)
+        self.plan = plan if plan is not None \
+            else ShardingPlan.from_strategy(compiled_strategy, model_spec)
         self.mesh = mesh if mesh is not None else self._mesh_from_plan()
         self._grad_fn = synchronization.make_grad_fn(
             self.plan, model_spec, self.mesh, loss_fn, has_aux=has_aux)

@@ -1,0 +1,225 @@
+"""Async / bounded-staleness PS mode (parallel/staleness.py).
+
+Mirrors the reference's staleness semantics test (``tests/integration/cases/
+c9.py:92-126``: a fast worker can run exactly ``staleness`` steps ahead of the
+slowest before blocking) plus value checks for the fully-async regime.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist
+from autodist_tpu.parallel.staleness import (AsyncPSRunner, StalenessController,
+                                             StalenessTimeout)
+from autodist_tpu.runner import DistributedRunner
+from autodist_tpu.strategy import PS
+
+LR = 0.1
+BATCH = 16
+
+
+def _data(seed=123):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(BATCH).astype(np.float32)
+    y = (3.0 * x + 2.0 + 0.1 * rng.randn(BATCH)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def _loss(p, batch):
+    pred = batch["x"] * p["w"] + p["b"]
+    return jnp.mean((batch["y"] - pred) ** 2)
+
+
+def _params():
+    return {"w": jnp.zeros(()), "b": jnp.zeros(())}
+
+
+# ------------------------------------------------------------------ controller unit
+
+def test_controller_allows_exactly_staleness_steps_ahead():
+    c = StalenessController(num_workers=2, staleness=3)
+    for _ in range(3):
+        c.start_step(0, timeout=1)
+        c.finish_step(0)
+    # 3 ahead of worker 1 (at 0): the 4th start must block.
+    with pytest.raises(StalenessTimeout):
+        c.start_step(0, timeout=0.1)
+    # Slow worker completes one step -> exactly one more step opens up.
+    c.start_step(1, timeout=1)
+    c.finish_step(1)
+    c.start_step(0, timeout=1)
+    c.finish_step(0)
+    with pytest.raises(StalenessTimeout):
+        c.start_step(0, timeout=0.1)
+    assert c.steps == [4, 1]
+
+
+def test_controller_unbounded_when_staleness_zero():
+    c = StalenessController(num_workers=2, staleness=0)
+    for _ in range(100):
+        c.start_step(0, timeout=0.1)
+        c.finish_step(0)
+    assert c.steps == [100, 0]
+
+
+def test_controller_validates_args():
+    with pytest.raises(ValueError):
+        StalenessController(num_workers=0)
+    with pytest.raises(ValueError):
+        StalenessController(num_workers=1, staleness=-1)
+
+
+# ------------------------------------------------------------------- runner dispatch
+
+def test_autodist_dispatches_async_runner():
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(LR),
+                                           example_batch=batch)
+    assert isinstance(runner, AsyncPSRunner)
+
+
+def test_autodist_dispatches_async_runner_for_staleness():
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(sync=True, staleness=2))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(LR),
+                                           example_batch=batch, num_workers=2)
+    assert isinstance(runner, AsyncPSRunner)
+    assert runner.staleness == 2
+    assert runner.num_workers == 2
+
+
+def test_sync_ps_still_uses_spmd_runner():
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS())
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(LR),
+                                           example_batch=batch)
+    assert isinstance(runner, DistributedRunner)
+    assert not isinstance(runner, AsyncPSRunner)
+
+
+# --------------------------------------------------------------------- value checks
+
+def test_async_single_worker_matches_sequential_sgd():
+    """One async worker is plain sequential SGD: value-exact vs numpy (c0-style)."""
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    step = ad.function(_loss, _params(), optax.sgd(LR), example_batch=batch)
+
+    w = b = 0.0
+    for _ in range(5):
+        step(batch)
+        x, y = batch["x"], batch["y"]
+        resid = y - (w * x + b)
+        w, b = w - LR * np.mean(-2.0 * x * resid), b - LR * np.mean(-2.0 * resid)
+
+    got = step.runner.service.state.params
+    np.testing.assert_allclose(float(got["w"]), w, rtol=1e-5)
+    np.testing.assert_allclose(float(got["b"]), b, rtol=1e-5)
+
+
+def test_bounded_staleness_worker_gate_c9_parity():
+    """Fast worker runs exactly ``staleness`` steps ahead, blocks, then resumes one
+    step per slow-worker step (reference c9.py:92-126 asserted this by wall-clock)."""
+    staleness = 3
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(staleness=staleness))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(LR),
+                                           example_batch=batch, num_workers=2)
+    runner.init(_params())
+    fast, slow = runner.worker(0), runner.worker(1)
+
+    for _ in range(staleness):
+        fast.step(batch, timeout=5)
+    with pytest.raises(StalenessTimeout):
+        fast.step(batch, timeout=0.2)
+    assert fast.steps_completed == staleness
+
+    slow.step(batch, timeout=5)
+    fast.step(batch, timeout=5)
+    with pytest.raises(StalenessTimeout):
+        fast.step(batch, timeout=0.2)
+    assert fast.steps_completed == staleness + 1
+    assert runner.service.version == fast.steps_completed + slow.steps_completed
+
+
+def test_concurrent_async_workers_apply_all_updates():
+    """Two threaded workers, unbounded async: every pushed gradient is applied and
+    the model still converges."""
+    n_steps = 8
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.05),
+                                           example_batch=batch, num_workers=2)
+    runner.init(_params())
+    l0 = float(_loss(runner.service.state.params, batch))
+
+    def drive(worker_id):
+        w = runner.worker(worker_id)
+        for _ in range(n_steps):
+            w.step(batch, timeout=30)
+
+    threads = [threading.Thread(target=drive, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+
+    assert runner.service.version == 2 * n_steps
+    l1 = float(_loss(runner.service.state.params, batch))
+    assert l1 < l0
+
+
+def test_async_aux_metrics_pass_through():
+    """has_aux losses return their real aux in async mode (not a dropped stub)."""
+    batch = _data()
+
+    def loss_aux(p, b):
+        pred = b["x"] * p["w"] + p["b"]
+        loss = jnp.mean((b["y"] - pred) ** 2)
+        return loss, {"mean_pred": jnp.mean(pred)}
+
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    step = ad.function(loss_aux, _params(), optax.sgd(LR), example_batch=batch,
+                       has_aux=True)
+    loss, aux = step(batch)
+    assert float(loss) > 0
+    assert "mean_pred" in aux
+
+
+def test_async_restore_reseeds_before_updates_and_raises_after():
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(LR),
+                                           example_batch=batch)
+    state0 = runner.init(_params())
+    # A foreign (e.g. checkpoint-restored) state before any update re-seeds the PS.
+    import dataclasses
+    restored = dataclasses.replace(state0, params={"w": jnp.ones(()), "b": jnp.ones(())})
+    new_state, _ = runner.run(restored, batch)
+    assert runner.service.version == 1
+    # After updates, a foreign state is ambiguous -> explicit restore required.
+    with pytest.raises(RuntimeError, match="restore"):
+        runner.run(restored, batch)
+    runner.restore(restored)
+    assert float(runner.service.state.params["w"]) == 1.0
+
+
+def test_stale_snapshot_is_immutable():
+    """A worker's stale params reference survives later applies (no donation)."""
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(LR),
+                                           example_batch=batch)
+    runner.init(_params())
+    snap, _ef, v0 = runner.service.read()
+    w0 = float(snap["w"])
+    runner.worker(0).step(batch)
+    runner.worker(0).step(batch)
+    assert runner.service.version == v0 + 2
+    assert float(snap["w"]) == w0  # old version still readable, unchanged
