@@ -14,8 +14,13 @@ from autodist_tpu.models.resnet import ResNet, ResNet50Config
 from autodist_tpu.models.bert import Bert, BertConfig
 from autodist_tpu.models.vgg import VGG16
 from autodist_tpu.models.ncf import NeuMF, NeuMFConfig
+from autodist_tpu.models.densenet import DenseNet, DenseNet121Config
+from autodist_tpu.models.inception import InceptionV3, InceptionV3Config
+from autodist_tpu.models.lstm_lm import LSTMLMWithHead, LSTMLMConfig
 
 __all__ = [
     "TransformerLM", "TransformerLMConfig", "ResNet", "ResNet50Config",
     "Bert", "BertConfig", "VGG16", "NeuMF", "NeuMFConfig",
+    "DenseNet", "DenseNet121Config", "InceptionV3", "InceptionV3Config",
+    "LSTMLMWithHead", "LSTMLMConfig",
 ]

@@ -6,6 +6,15 @@ import jax
 import jax.numpy as jnp
 
 
+def num_groups(channels: int, max_groups: int) -> int:
+    """Largest GroupNorm group count <= max_groups that divides the channel count
+    (CNN widths like 80/48/76 are not multiples of the usual 32)."""
+    g = min(max_groups, channels)
+    while channels % g:
+        g -= 1
+    return g
+
+
 def make_classification_loss_fn(model) -> Callable:
     """Softmax cross entropy over {"images", "labels"} batches (ResNet/VGG style)."""
 

@@ -1,0 +1,117 @@
+"""DenseNet-121 for ImageNet-class benchmarks.
+
+Counterpart of the reference's Keras DenseNet121 benchmark entry
+(``examples/benchmark/imagenet.py:150-170`` selects it with per-model AllReduce
+chunk sizes). Same TPU-first choices as ``models/resnet.py``: NHWC layout,
+bfloat16 activations over float32 parameters, and GroupNorm instead of BatchNorm
+so the train step stays a pure function of (params, batch) with no running
+statistics to synchronize. Dense blocks use pre-activation norm→relu→conv
+ordering; concatenations are along the channel axis, which XLA fuses into the
+following 1x1 conv on the MXU.
+"""
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseNet121Config:
+    num_classes: int = 1000
+    block_sizes: Sequence[int] = (6, 12, 24, 16)   # DenseNet-121
+    growth_rate: int = 32
+    init_features: int = 64
+    bottleneck_width: int = 4                      # 1x1 conv emits width*growth chans
+    compression: float = 0.5                       # transition channel reduction
+    dtype: Any = jnp.bfloat16
+    norm_groups: int = 32
+
+
+def _norm(channels: int, cfg: DenseNet121Config, name: str):
+    from autodist_tpu.models.common import num_groups
+    return nn.GroupNorm(num_groups=num_groups(channels, cfg.norm_groups),
+                        dtype=cfg.dtype, name=name)
+
+
+class DenseLayer(nn.Module):
+    """norm→relu→1x1 conv (bottleneck) → norm→relu→3x3 conv, concat with input."""
+
+    config: DenseNet121Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        inter = cfg.bottleneck_width * cfg.growth_rate
+        y = nn.relu(_norm(x.shape[-1], cfg, "norm1")(x))
+        y = nn.Conv(inter, (1, 1), use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name="conv1")(y)
+        y = nn.relu(_norm(inter, cfg, "norm2")(y))
+        y = nn.Conv(cfg.growth_rate, (3, 3), use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name="conv2")(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    """norm→relu→1x1 conv (compression) → 2x2 average pool."""
+
+    config: DenseNet121Config
+    out_channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        y = nn.relu(_norm(x.shape[-1], cfg, "norm")(x))
+        y = nn.Conv(self.out_channels, (1, 1), use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name="conv")(y)
+        return nn.avg_pool(y, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    config: DenseNet121Config
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(cfg.init_features, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=jnp.float32, name="conv_init")(x)
+        x = nn.relu(_norm(cfg.init_features, cfg, "norm_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        channels = cfg.init_features
+        for stage, n_layers in enumerate(cfg.block_sizes):
+            for layer in range(n_layers):
+                x = DenseLayer(cfg, name=f"block{stage}_layer{layer}")(x)
+                channels += cfg.growth_rate
+            if stage != len(cfg.block_sizes) - 1:
+                channels = int(channels * cfg.compression)
+                x = Transition(cfg, channels, name=f"transition{stage}")(x)
+
+        x = nn.relu(_norm(channels, cfg, "norm_final")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def make_loss_fn(model: DenseNet) -> Callable:
+    from autodist_tpu.models.common import make_classification_loss_fn
+    return make_classification_loss_fn(model)
+
+
+def init_params(config: DenseNet121Config, rng=None, image_size: int = 224):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = DenseNet(config)
+    images = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
+    return model, model.init(rng, images)["params"]
+
+
+def synthetic_batch(config: DenseNet121Config, batch_size: int,
+                    image_size: int = 224, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "images": rng.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "labels": rng.randint(0, config.num_classes, size=(batch_size,)).astype(np.int32),
+    }

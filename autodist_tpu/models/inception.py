@@ -1,0 +1,203 @@
+"""Inception-V3 for ImageNet-class benchmarks.
+
+Counterpart of the reference's Keras InceptionV3 benchmark entry
+(``examples/benchmark/imagenet.py:150-170``, chunk_size=30). Same TPU-first
+choices as ``models/resnet.py``: NHWC, bfloat16 activations over float32
+parameters, GroupNorm instead of BatchNorm (pure train step, nothing to
+synchronize). The branch structure is kept — XLA fuses each branch's
+conv→norm→relu chain and the final channel concat feeds the next block's 1x1
+convs on the MXU. The auxiliary classifier head is omitted (the reference
+benchmark ran inference-topology Keras models without aux loss as well).
+"""
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.common import num_groups as _num_groups
+
+
+@dataclasses.dataclass(frozen=True)
+class InceptionV3Config:
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    norm_groups: int = 32
+
+
+class ConvNorm(nn.Module):
+    """conv → GroupNorm → relu, the basic Inception cell."""
+
+    config: InceptionV3Config
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name="conv")(x)
+        x = nn.GroupNorm(num_groups=_num_groups(self.features, cfg.norm_groups),
+                         dtype=cfg.dtype, name="norm")(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    config: InceptionV3Config
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b1 = ConvNorm(cfg, 64, (1, 1), name="b1_1x1")(x)
+        b2 = ConvNorm(cfg, 48, (1, 1), name="b2_1x1")(x)
+        b2 = ConvNorm(cfg, 64, (5, 5), name="b2_5x5")(b2)
+        b3 = ConvNorm(cfg, 64, (1, 1), name="b3_1x1")(x)
+        b3 = ConvNorm(cfg, 96, (3, 3), name="b3_3x3a")(b3)
+        b3 = ConvNorm(cfg, 96, (3, 3), name="b3_3x3b")(b3)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvNorm(cfg, self.pool_features, (1, 1), name="b4_pool")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 → 17x17."""
+
+    config: InceptionV3Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b1 = ConvNorm(cfg, 384, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b1_3x3")(x)
+        b2 = ConvNorm(cfg, 64, (1, 1), name="b2_1x1")(x)
+        b2 = ConvNorm(cfg, 96, (3, 3), name="b2_3x3a")(b2)
+        b2 = ConvNorm(cfg, 96, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b2_3x3b")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches at 17x17 resolution."""
+
+    config: InceptionV3Config
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        c7 = self.channels_7x7
+        b1 = ConvNorm(cfg, 192, (1, 1), name="b1_1x1")(x)
+        b2 = ConvNorm(cfg, c7, (1, 1), name="b2_1x1")(x)
+        b2 = ConvNorm(cfg, c7, (1, 7), name="b2_1x7")(b2)
+        b2 = ConvNorm(cfg, 192, (7, 1), name="b2_7x1")(b2)
+        b3 = ConvNorm(cfg, c7, (1, 1), name="b3_1x1")(x)
+        b3 = ConvNorm(cfg, c7, (7, 1), name="b3_7x1a")(b3)
+        b3 = ConvNorm(cfg, c7, (1, 7), name="b3_1x7a")(b3)
+        b3 = ConvNorm(cfg, c7, (7, 1), name="b3_7x1b")(b3)
+        b3 = ConvNorm(cfg, 192, (1, 7), name="b3_1x7b")(b3)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvNorm(cfg, 192, (1, 1), name="b4_pool")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 → 8x8."""
+
+    config: InceptionV3Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b1 = ConvNorm(cfg, 192, (1, 1), name="b1_1x1")(x)
+        b1 = ConvNorm(cfg, 320, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b1_3x3")(b1)
+        b2 = ConvNorm(cfg, 192, (1, 1), name="b2_1x1")(x)
+        b2 = ConvNorm(cfg, 192, (1, 7), name="b2_1x7")(b2)
+        b2 = ConvNorm(cfg, 192, (7, 1), name="b2_7x1")(b2)
+        b2 = ConvNorm(cfg, 192, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b2_3x3")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filterbank blocks at 8x8 resolution."""
+
+    config: InceptionV3Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b1 = ConvNorm(cfg, 320, (1, 1), name="b1_1x1")(x)
+        b2 = ConvNorm(cfg, 384, (1, 1), name="b2_1x1")(x)
+        b2 = jnp.concatenate([ConvNorm(cfg, 384, (1, 3), name="b2_1x3")(b2),
+                              ConvNorm(cfg, 384, (3, 1), name="b2_3x1")(b2)], axis=-1)
+        b3 = ConvNorm(cfg, 448, (1, 1), name="b3_1x1")(x)
+        b3 = ConvNorm(cfg, 384, (3, 3), name="b3_3x3")(b3)
+        b3 = jnp.concatenate([ConvNorm(cfg, 384, (1, 3), name="b3_1x3")(b3),
+                              ConvNorm(cfg, 384, (3, 1), name="b3_3x1")(b3)], axis=-1)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvNorm(cfg, 192, (1, 1), name="b4_pool")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    config: InceptionV3Config
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        # Stem: 299x299x3 → 35x35x192.
+        x = ConvNorm(cfg, 32, (3, 3), strides=(2, 2), padding="VALID",
+                     name="stem_conv1")(x)
+        x = ConvNorm(cfg, 32, (3, 3), padding="VALID", name="stem_conv2")(x)
+        x = ConvNorm(cfg, 64, (3, 3), name="stem_conv3")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = ConvNorm(cfg, 80, (1, 1), name="stem_conv4")(x)
+        x = ConvNorm(cfg, 192, (3, 3), padding="VALID", name="stem_conv5")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(cfg, 32, name="mixed0")(x)
+        x = InceptionA(cfg, 64, name="mixed1")(x)
+        x = InceptionA(cfg, 64, name="mixed2")(x)
+        x = InceptionB(cfg, name="mixed3")(x)
+        x = InceptionC(cfg, 128, name="mixed4")(x)
+        x = InceptionC(cfg, 160, name="mixed5")(x)
+        x = InceptionC(cfg, 160, name="mixed6")(x)
+        x = InceptionC(cfg, 192, name="mixed7")(x)
+        x = InceptionD(cfg, name="mixed8")(x)
+        x = InceptionE(cfg, name="mixed9")(x)
+        x = InceptionE(cfg, name="mixed10")(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def make_loss_fn(model: InceptionV3) -> Callable:
+    from autodist_tpu.models.common import make_classification_loss_fn
+    return make_classification_loss_fn(model)
+
+
+def init_params(config: InceptionV3Config, rng=None, image_size: int = 299):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = InceptionV3(config)
+    images = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
+    return model, model.init(rng, images)["params"]
+
+
+def synthetic_batch(config: InceptionV3Config, batch_size: int,
+                    image_size: int = 299, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {
+        "images": rng.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "labels": rng.randint(0, config.num_classes, size=(batch_size,)).astype(np.int32),
+    }

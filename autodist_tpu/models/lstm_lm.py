@@ -1,0 +1,137 @@
+"""LSTM language model with sampled softmax — exact model-family parity with lm1b.
+
+The reference's lm1b workload is an LSTM LM over a 793k-word vocabulary trained
+with sampled softmax (``examples/lm1b/language_model.py:15-30``). The flagship
+TPU workload here is the Transformer LM (``models/transformer_lm.py``), but this
+module keeps the reference's exact model family available:
+
+- The recurrence runs as a compiled ``lax.scan`` (via ``flax.linen.RNN`` over
+  ``OptimizedLSTMCell``) — one fused cell matmul per step on the MXU, no Python
+  per-timestep loop, static shapes throughout.
+- Sampled softmax uses a **host-sampled static negative set** per batch
+  (``neg_ids`` in the batch dict): TPU-friendly because the gather of sampled
+  output-projection rows has a static shape, and the train step stays a pure
+  function of (params, batch). The reference sampled candidates inside the graph
+  with TF's log-uniform sampler; sampling on host keeps the step jittable and
+  reproducible.
+- The softmax weights are a separate (vocab, hidden) parameter, untied from the
+  input embedding like the reference — both carry row-sparse gradients, which the
+  Parallax strategy routes to PS (``parallax_strategy.py:24-71`` semantics).
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTMLMConfig:
+    vocab_size: int = 32000       # reference lm1b: 793_471
+    emb_dim: int = 512
+    hidden_dim: int = 1024
+    n_layers: int = 2
+    num_sampled: int = 1024       # sampled-softmax negatives per batch
+    dtype: Any = jnp.bfloat16
+
+
+class LSTMLM(nn.Module):
+    """Embedding → stacked LSTM → hidden states; the loss head lives in the loss fn
+    so the sampled-softmax projection can gather only the rows it needs."""
+
+    config: LSTMLMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.emb_dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        for i in range(cfg.n_layers):
+            # nn.RNN lowers to lax.scan over the sequence axis; the cell's four
+            # gates are one fused matmul per step.
+            x = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden_dim, dtype=cfg.dtype,
+                                            param_dtype=jnp.float32),
+                       name=f"lstm_{i}")(x)
+        return x  # [B, T, hidden]
+
+
+class LSTMLMWithHead(nn.Module):
+    """Wrapper owning the softmax projection so it lives in the same params tree."""
+
+    config: LSTMLMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        h = LSTMLM(cfg, name="lm")(tokens)
+        # Parameters are declared here; the loss fn gathers rows out of them.
+        self.param("softmax_w", nn.initializers.normal(0.02),
+                   (cfg.vocab_size, cfg.hidden_dim), jnp.float32)
+        self.param("softmax_b", nn.initializers.zeros, (cfg.vocab_size,),
+                   jnp.float32)
+        return h
+
+
+def make_loss_fn(model: LSTMLMWithHead) -> Callable:
+    """Sampled-softmax NLL.
+
+    Batch dict: ``tokens`` int32 [B, L+1] (inputs/targets shifted internally) and
+    optional ``neg_ids`` int32 [S] of host-sampled negative class ids. Without
+    ``neg_ids`` the loss falls back to the full softmax (used for eval and for
+    small-vocab tests).
+    """
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        h = model.apply({"params": params}, inputs).astype(jnp.float32)
+        w = params["softmax_w"]            # [V, H]
+        b = params["softmax_b"]            # [V]
+
+        if "neg_ids" not in batch:
+            logits = h @ w.T + b
+            logprobs = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+            return nll.mean()
+
+        neg_ids = batch["neg_ids"]         # [S], static length
+        # True-class logit: gather one row per target (row-sparse grad on w).
+        w_true = w[targets]                                   # [B, T, H]
+        true_logit = jnp.einsum("bth,bth->bt", h, w_true) + b[targets]
+        # Sampled negatives: one shared [S, H] gather for the whole batch.
+        w_neg = w[neg_ids]                                    # [S, H]
+        neg_logits = jnp.einsum("bth,sh->bts", h, w_neg) + b[neg_ids]
+        # Mask accidental hits (a sampled id equal to the true target) so the
+        # model is not penalized for assigning them probability (standard
+        # sampled-softmax accidental-hit removal).
+        hits = neg_ids[None, None, :] == targets[..., None]   # [B, T, S]
+        neg_logits = jnp.where(hits, jnp.full_like(neg_logits, -1e9), neg_logits)
+        # Softmax over [true | negatives]; NLL of the true class is position 0.
+        all_logits = jnp.concatenate([true_logit[..., None], neg_logits], axis=-1)
+        return (-true_logit + jax.nn.logsumexp(all_logits, axis=-1)).mean()
+
+    return loss_fn
+
+
+def init_params(config: LSTMLMConfig, rng: Optional[jax.Array] = None,
+                batch_size: int = 2):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = LSTMLMWithHead(config)
+    tokens = jnp.zeros((batch_size, 8), jnp.int32)
+    return model, model.init(rng, tokens)["params"]
+
+
+def synthetic_batch(config: LSTMLMConfig, batch_size: int, seq_len: int,
+                    seed: int = 0, sampled: bool = True):
+    rng = np.random.RandomState(seed)
+    batch = {"tokens": rng.randint(0, config.vocab_size,
+                                   size=(batch_size, seq_len + 1)).astype(np.int32)}
+    if sampled:
+        # Host-side log-uniform (Zipfian) candidate sampling, matching the
+        # distribution TF's LogUniformCandidateSampler draws from.
+        u = rng.uniform(size=(config.num_sampled,))
+        ids = (np.exp(u * np.log(config.vocab_size + 1)) - 1).astype(np.int64)
+        batch["neg_ids"] = np.clip(ids, 0, config.vocab_size - 1).astype(np.int32)
+    return batch

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from autodist_tpu.models.common import num_groups
+
 
 @dataclasses.dataclass(frozen=True)
 class ResNet50Config:
@@ -36,7 +38,7 @@ class BottleneckBlock(nn.Module):
     def __call__(self, x):
         cfg = self.config
         norm = lambda name: nn.GroupNorm(  # noqa: E731
-            num_groups=min(cfg.norm_groups, self.filters), dtype=cfg.dtype, name=name)
+            num_groups=num_groups(self.filters, cfg.norm_groups), dtype=cfg.dtype, name=name)
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name="conv1")(x)
@@ -47,7 +49,7 @@ class BottleneckBlock(nn.Module):
         y = nn.relu(norm("norm2")(y))
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name="conv3")(y)
-        y = nn.GroupNorm(num_groups=min(cfg.norm_groups, self.filters * 4),
+        y = nn.GroupNorm(num_groups=num_groups(self.filters * 4, cfg.norm_groups),
                          dtype=cfg.dtype, name="norm3")(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters * 4, (1, 1),
@@ -66,7 +68,7 @@ class ResNet(nn.Module):
         x = images.astype(cfg.dtype)
         x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
                     dtype=cfg.dtype, param_dtype=jnp.float32, name="conv_init")(x)
-        x = nn.relu(nn.GroupNorm(num_groups=min(cfg.norm_groups, cfg.width),
+        x = nn.relu(nn.GroupNorm(num_groups=num_groups(cfg.width, cfg.norm_groups),
                                  dtype=cfg.dtype, name="norm_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(cfg.stage_sizes):

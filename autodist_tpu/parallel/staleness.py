@@ -127,6 +127,22 @@ class ParameterService:
             self._version += 1
             return self._version
 
+    def adopt(self, state: TrainState, place_fn) -> None:
+        """Atomically adopt a foreign state iff no updates have been applied yet
+        (the checkpoint-restore pattern). The identity check, version check, and
+        replacement happen under one lock hold so a concurrently stepping worker
+        cannot slip an ``apply`` between check and reset."""
+        with self._lock:
+            if state is self._state:
+                return
+            if self._version != 0:
+                raise RuntimeError(
+                    "AsyncPSRunner.run was handed a state that is not the service's "
+                    "current state after updates were already applied; use "
+                    "restore(state) to adopt a checkpoint explicitly")
+            self._state = place_fn(state)
+            self._version = 0
+
 
 class AsyncWorker:
     """One logical worker's handle (reference: one re-executed user script per node)."""
@@ -146,6 +162,7 @@ class AsyncWorker:
         params, ef_state, version = r.service.read()
         self.last_version_read = version
         sharded = r.shard_batch(batch)
+        r._maybe_dump_async_graphs(params, sharded, ef_state)
         with r.mesh:
             grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
             r.service.apply(grads)
@@ -188,6 +205,8 @@ class AsyncPSRunner(DistributedRunner):
         # the (jitted) sync step_fn, so compile it here.
         self._jit_grad_fn = jax.jit(self._grad_fn)
         self._workers = {i: AsyncWorker(self, i) for i in range(self.num_workers)}
+        self._dump_lock = threading.Lock()
+        self._dumped = False
         logging.info("AsyncPSRunner: %d worker(s), staleness=%s",
                      self.num_workers, self.staleness or "unbounded")
 
@@ -237,6 +256,26 @@ class AsyncPSRunner(DistributedRunner):
         with self.mesh:
             self.service.reset(place(state))
 
+    def _maybe_dump_async_graphs(self, params, sharded_batch, ef_state):
+        """AUTODIST_DUMP_GRAPHS stage snapshots for the async regime (the sync
+        runner dumps in _build_step; async steps bypass it). Dumped once, from
+        whichever worker steps first: 0-original = the user's loss fn,
+        1-distributed = the gated grad fn the workers actually run (the PS-side
+        apply is serialized on the service and has no per-step graph)."""
+        from autodist_tpu import const
+        if not const.ENV.AUTODIST_DUMP_GRAPHS.val:
+            return
+        with self._dump_lock:
+            if self._dumped:
+                return
+            self._dumped = True
+        from autodist_tpu.utils import tracing
+        with self.mesh:
+            tracing.dump_stage("async_step", "0-original", self._loss_fn,
+                               params, sharded_batch)
+            tracing.dump_stage("async_step", "1-distributed", self._grad_fn,
+                               params, sharded_batch, ef_state)
+
     # --------------------------------------------------------------------- run
     def run(self, state, batch: PyTree = None, worker_id: int = 0):
         """Drop-in step: one async step on ``worker_id``; returns
@@ -250,15 +289,14 @@ class AsyncPSRunner(DistributedRunner):
         service past the caller's snapshot — and raises."""
         if batch is None:
             state, batch = None, state
-        if state is not None and self.service is not None \
-                and state is not self.service.state:
-            if self.service.version == 0:
-                self.restore(state)
-            else:
-                raise RuntimeError(
-                    "AsyncPSRunner.run was handed a state that is not the service's "
-                    "current state after updates were already applied; use "
-                    "restore(state) to adopt a checkpoint explicitly")
+        if state is not None and self.service is not None:
+            place = jax.jit(lambda s: s, out_shardings=self._state_shardings)
+
+            def placer(s):
+                with self.mesh:
+                    return place(s)
+
+            self.service.adopt(state, placer)
         fetched = self.worker(worker_id).step(batch, timeout=self.DEFAULT_STEP_TIMEOUT)
         return self.service.state, fetched
 

@@ -17,13 +17,17 @@ import jax.numpy as jnp
 import optax
 
 from autodist_tpu import AutoDist
-from autodist_tpu.models import transformer_lm
+from autodist_tpu.models import lstm_lm, transformer_lm
 from autodist_tpu.strategy import Parallax
 from autodist_tpu.utils.metrics import ThroughputMeter
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser()
+    parser.add_argument("--model", choices=["transformer", "lstm"],
+                        default="transformer",
+                        help="'lstm' = the reference's exact model family "
+                             "(LSTM + sampled softmax)")
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("--seq_len", type=int, default=256)
@@ -36,14 +40,23 @@ def main(argv=None):
 
     import jax
     on_accel = jax.default_backend() != "cpu"
-    cfg = transformer_lm.TransformerLMConfig(
-        vocab_size=args.vocab, d_model=args.d_model, n_heads=8,
-        n_layers=args.n_layers, d_ff=4 * args.d_model, max_len=args.seq_len + 1,
-        dtype=jnp.bfloat16 if on_accel else jnp.float32, tied_output=False)
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
 
-    model, params = transformer_lm.init_params(cfg)
-    loss_fn = transformer_lm.make_loss_fn(model)
-    batch = transformer_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
+    if args.model == "lstm":
+        cfg = lstm_lm.LSTMLMConfig(
+            vocab_size=args.vocab, emb_dim=args.d_model,
+            hidden_dim=2 * args.d_model, n_layers=args.n_layers, dtype=dtype)
+        model, params = lstm_lm.init_params(cfg)
+        loss_fn = lstm_lm.make_loss_fn(model)
+        batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
+    else:
+        cfg = transformer_lm.TransformerLMConfig(
+            vocab_size=args.vocab, d_model=args.d_model, n_heads=8,
+            n_layers=args.n_layers, d_ff=4 * args.d_model, max_len=args.seq_len + 1,
+            dtype=dtype, tied_output=False)
+        model, params = transformer_lm.init_params(cfg)
+        loss_fn = transformer_lm.make_loss_fn(model)
+        batch = transformer_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
 
     ad = AutoDist(args.resource_spec, strategy_builder=Parallax())
     step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)

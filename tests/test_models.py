@@ -108,3 +108,64 @@ def test_ncf_trains_parallax_sparse():
     kinds = {n.var_name: n.WhichOneof("synchronizer") for n in ad._strategy.node_config}
     emb = [k for n, k in kinds.items() if "embed" in n and "embedding" in n.lower()]
     assert emb and all(k == "ps_synchronizer" for k in emb)
+
+
+def test_densenet_tiny_trains():
+    from autodist_tpu.models import densenet
+    cfg = densenet.DenseNet121Config(num_classes=10, block_sizes=(2, 2),
+                                     growth_rate=8, init_features=16,
+                                     dtype=jnp.float32, norm_groups=4)
+    model, params = densenet.init_params(cfg, image_size=32)
+    loss_fn = densenet.make_loss_fn(model)
+    batch = densenet.synthetic_batch(cfg, batch_size=8, image_size=32)
+    ad = AutoDist(strategy_builder=AllReduce())
+    step = ad.function(loss_fn, params, optax.sgd(0.05), example_batch=batch)
+    losses = [float(step(batch)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_inception_v3_tiny_trains():
+    from autodist_tpu.models import inception
+    # Full-size stem needs 299px; a reduced 96px input still exercises every
+    # block type (A, B grid-reduce, C factorized-7x7, D, E).
+    cfg = inception.InceptionV3Config(num_classes=10, dtype=jnp.float32,
+                                      norm_groups=4)
+    model, params = inception.init_params(cfg, image_size=96)
+    loss_fn = inception.make_loss_fn(model)
+    batch = inception.synthetic_batch(cfg, batch_size=4, image_size=96)
+    ad = AutoDist(strategy_builder=AllReduce())
+    # Inception's init produces large early gradients (~55 global norm at this
+    # size); SGD at CNN-test rates diverges, Adam converges.
+    step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)
+    losses = [float(step(batch)) for _ in range(3)]
+    # Random-label fitting at this depth is noisy step-to-step; the training
+    # signal asserted is: finite everywhere and an improvement over the start.
+    assert np.isfinite(losses).all() and min(losses[1:]) < losses[0]
+
+
+def test_lstm_lm_sampled_softmax_trains_parallax():
+    from autodist_tpu.models import lstm_lm
+    cfg = lstm_lm.LSTMLMConfig(vocab_size=256, emb_dim=16, hidden_dim=32,
+                               n_layers=2, num_sampled=64, dtype=jnp.float32)
+    model, params = lstm_lm.init_params(cfg)
+    loss_fn = lstm_lm.make_loss_fn(model)
+    batch = lstm_lm.synthetic_batch(cfg, batch_size=8, seq_len=12)
+    ad = AutoDist(strategy_builder=Parallax())
+    step = ad.function(loss_fn, params, optax.adam(1e-2), example_batch=batch)
+    losses = [float(step(batch)) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_lstm_lm_sampled_softmax_approximates_full_softmax():
+    # With every vocab id in the sampled set, sampled softmax == full softmax
+    # (accidental-hit masking removes the duplicated true class).
+    from autodist_tpu.models import lstm_lm
+    cfg = lstm_lm.LSTMLMConfig(vocab_size=32, emb_dim=8, hidden_dim=16,
+                               n_layers=1, num_sampled=32, dtype=jnp.float32)
+    model, params = lstm_lm.init_params(cfg)
+    loss_fn = lstm_lm.make_loss_fn(model)
+    batch = lstm_lm.synthetic_batch(cfg, batch_size=4, seq_len=8, sampled=False)
+    full = float(loss_fn(params, batch))
+    batch["neg_ids"] = np.arange(32, dtype=np.int32)
+    sampled = float(loss_fn(params, batch))
+    np.testing.assert_allclose(sampled, full, rtol=1e-5)
