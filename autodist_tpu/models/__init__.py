@@ -17,10 +17,11 @@ from autodist_tpu.models.ncf import NeuMF, NeuMFConfig
 from autodist_tpu.models.densenet import DenseNet, DenseNet121Config
 from autodist_tpu.models.inception import InceptionV3, InceptionV3Config
 from autodist_tpu.models.lstm_lm import LSTMLMWithHead, LSTMLMConfig
+from autodist_tpu.models.moe import MoETransformerLM, MoETransformerLMConfig
 
 __all__ = [
     "TransformerLM", "TransformerLMConfig", "ResNet", "ResNet50Config",
     "Bert", "BertConfig", "VGG16", "NeuMF", "NeuMFConfig",
     "DenseNet", "DenseNet121Config", "InceptionV3", "InceptionV3Config",
-    "LSTMLMWithHead", "LSTMLMConfig",
+    "LSTMLMWithHead", "LSTMLMConfig", "MoETransformerLM", "MoETransformerLMConfig",
 ]

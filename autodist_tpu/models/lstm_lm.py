@@ -35,6 +35,11 @@ class LSTMLMConfig:
     hidden_dim: int = 1024
     n_layers: int = 2
     num_sampled: int = 1024       # sampled-softmax negatives per batch
+    # Subtract log(expected sample probability) from sampled logits so the sampled
+    # objective is an unbiased estimate of the full softmax under the log-uniform
+    # sampler (TF sampled_softmax_loss's subtract_log_q=True default, which the
+    # reference lm1b relies on). Disable only for diagnostics.
+    subtract_log_q: bool = True
     dtype: Any = jnp.bfloat16
 
 
@@ -103,6 +108,18 @@ def make_loss_fn(model: LSTMLMWithHead) -> Callable:
         # Sampled negatives: one shared [S, H] gather for the whole batch.
         w_neg = w[neg_ids]                                    # [S, H]
         neg_logits = jnp.einsum("bth,sh->bts", h, w_neg) + b[neg_ids]
+        if model.config.subtract_log_q:
+            # Importance correction: logits -= log q(id) under the log-uniform
+            # sampler q(id) = (log(id+2) - log(id+1)) / log(V+1). Applied to the
+            # true class too (TF semantics); the shared log(V+1) and sample-count
+            # terms are constant across classes and cancel in the softmax.
+            def log_q(ids):
+                idf = ids.astype(jnp.float32)
+                return jnp.log(jnp.log1p(1.0 / (idf + 1.0))) - jnp.log(
+                    jnp.log(float(model.config.vocab_size + 1)))
+
+            true_logit = true_logit - log_q(targets)
+            neg_logits = neg_logits - log_q(neg_ids)[None, None, :]
         # Mask accidental hits (a sampled id equal to the true target) so the
         # model is not penalized for assigning them probability (standard
         # sampled-softmax accidental-hit removal).

@@ -1,0 +1,188 @@
+"""Mixture-of-Experts Transformer LM — the expert-parallel workload.
+
+The reference has no MoE or expert parallelism (its strategy nodes are variables
+only, ``strategy.proto:36-42``); this extends the framework beyond reference parity
+using the mesh's ``expert`` axis. The design is the standard TPU MoE formulation
+(GShard/Switch): routing is expressed as dense einsums against one-hot dispatch and
+combine tensors with a **static capacity** per expert, and expert FFN weights carry
+a leading expert dimension sharded ``P("expert", ...)``. Under ``jit`` the XLA SPMD
+partitioner turns the dispatch/return einsums into ``all_to_all``s over the expert
+axis — no manual collectives, and the per-expert matmuls stay MXU-shaped batched
+GEMMs.
+
+Top-1 (Switch) routing keeps shapes static: tokens beyond an expert's capacity are
+dropped (their combine weight is zero, so they pass through the residual only), the
+standard TPU-friendly trade.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.transformer_lm import (MultiHeadAttention,
+                                                TransformerLMConfig, causal_mask)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerLMConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 1024
+    n_experts: int = 8
+    capacity_factor: float = 1.25   # capacity = ceil(tokens/expert * factor)
+    router_aux_weight: float = 1e-2  # Switch load-balancing loss weight
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        if self.n_experts < 2:
+            raise ValueError("n_experts must be >= 2")
+
+    def attn_config(self) -> TransformerLMConfig:
+        """The dense attention sub-config reused from the dense LM."""
+        return TransformerLMConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, d_ff=self.d_ff, max_len=self.max_len,
+            dtype=self.dtype, tied_output=False)
+
+
+def switch_route(logits: jax.Array, capacity: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing with static capacity.
+
+    logits: [B, S, E] router scores. Returns (dispatch [B, S, E, C] one-hot,
+    combine [B, S, E, C] = dispatch * router probability, aux_loss scalar).
+    All shapes static; overflow tokens get all-zero dispatch rows.
+    """
+    n_experts = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                       # [B, S]
+    assignment = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+
+    # Position of each token within its expert's queue, in sequence order.
+    position = jnp.cumsum(assignment, axis=1) * assignment - 1.0   # [B, S, E]
+    in_capacity = (position >= 0) & (position < capacity)
+    dispatch = jnp.einsum(
+        "bse,bsec->bsec", assignment * in_capacity,
+        jax.nn.one_hot(jnp.clip(position, 0, capacity - 1).astype(jnp.int32),
+                       capacity, dtype=jnp.float32))
+
+    top_prob = jnp.max(probs, axis=-1)                             # [B, S]
+    combine = dispatch * top_prob[..., None, None]
+
+    # Switch aux loss: E * mean_e(fraction routed to e * mean router prob for e).
+    frac_routed = assignment.mean(axis=(0, 1))                     # [E]
+    mean_prob = probs.mean(axis=(0, 1))                            # [E]
+    aux = n_experts * jnp.sum(frac_routed * mean_prob)
+    return dispatch, combine, aux
+
+
+class MoEFFN(nn.Module):
+    """Expert-parallel FFN: route -> all_to_all (implicit) -> batched GEMM -> return."""
+
+    config: MoETransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, m = x.shape
+        capacity = int(np.ceil(s * cfg.capacity_factor / cfg.n_experts)) or 1
+
+        router = nn.Dense(cfg.n_experts, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")
+        # Expert weights: leading expert dim — the plan shards it P("expert",..).
+        w_in = self.param("experts_in", nn.initializers.lecun_normal(),
+                          (cfg.n_experts, m, cfg.d_ff), jnp.float32)
+        w_out = self.param("experts_out", nn.initializers.lecun_normal(),
+                           (cfg.n_experts, cfg.d_ff, m), jnp.float32)
+
+        dispatch, combine, aux = switch_route(router(x), capacity)
+        dispatch = dispatch.astype(cfg.dtype)
+        combine = combine.astype(cfg.dtype)
+
+        # Dispatch einsum: XLA inserts the token all_to_all (data <-> expert axes).
+        expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch, x)
+        h = jnp.einsum("ebcm,emf->ebcf", expert_in, w_in.astype(cfg.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ebcf,efm->ebcm", h, w_out.astype(cfg.dtype))
+        y = jnp.einsum("bsec,ebcm->bsm", combine, expert_out)
+        return y, aux
+
+
+class MoEBlock(nn.Module):
+    config: MoETransformerLMConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.config
+        attn_cfg = cfg.attn_config()
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        x = x + MultiHeadAttention(attn_cfg, name="attn")(h, mask)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_moe")(x)
+        y, aux = MoEFFN(cfg, name="moe")(h)
+        return x + y, aux
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with an MoE FFN in every block. Returns (logits, aux_loss)."""
+
+    config: MoETransformerLMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        _, length = tokens.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="embed")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.d_model), jnp.float32)
+        x = emb(tokens) + pos[None, :length, :].astype(cfg.dtype)
+        mask = causal_mask(length, cfg.dtype)
+
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.n_layers):
+            x, aux = MoEBlock(cfg, name=f"block_{i}")(x, mask)
+            aux_total = aux_total + aux
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                          name="lm_head")(x.astype(jnp.float32))
+        return logits, aux_total / cfg.n_layers
+
+
+def make_loss_fn(model: MoETransformerLM) -> Callable:
+    """Next-token cross entropy + router load-balancing aux loss."""
+    cfg = model.config
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits, aux = model.apply({"params": params}, inputs)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        return nll.mean() + cfg.router_aux_weight * aux
+
+    return loss_fn
+
+
+def init_params(config: MoETransformerLMConfig, rng: Optional[jax.Array] = None,
+                batch_size: int = 2):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = MoETransformerLM(config)
+    tokens = jnp.zeros((batch_size, min(8, config.max_len)), jnp.int32)
+    return model, model.init(rng, tokens)["params"]
+
+
+def synthetic_batch(config: MoETransformerLMConfig, batch_size: int, seq_len: int,
+                    seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {"tokens": rng.randint(0, config.vocab_size,
+                                  size=(batch_size, seq_len + 1)).astype(np.int32)}

@@ -52,8 +52,11 @@ class ParamPlan:
     sparse: bool = False
     staleness: int = 0
     synchronous: bool = True
-    partition_axis: Optional[int] = None   # tensor axis sharded on the model axis
+    partition_axis: Optional[int] = None   # tensor axis sharded on a mesh axis
     num_shards: Tuple[int, ...] = ()       # logical shard counts from the strategy
+    # Mesh axis the partition maps onto: "model" for tensor parallelism, "expert"
+    # for expert parallelism (PartitionConfig.mesh_axis).
+    partition_mesh_axis: str = const.MESH_AXIS_MODEL
 
 
 class ShardingPlan:
@@ -69,8 +72,6 @@ class ShardingPlan:
     def from_strategy(cls, strategy, model_spec: ModelSpec) -> "ShardingPlan":
         mesh_axes = collections.OrderedDict(
             (a.name, a.size) for a in strategy.mesh_config.axes)
-        model_size = mesh_axes.get(const.MESH_AXIS_MODEL, 1)
-        reduce_size = mesh_axes.get(const.MESH_AXIS_REDUCE, 1)
 
         nodes = {n.var_name: n for n in strategy.node_config}
         plans: Dict[str, ParamPlan] = {}
@@ -80,11 +81,12 @@ class ShardingPlan:
                                         sync=SYNC_ALLREDUCE)
                 continue
             node = nodes.get(name)
-            plans[name] = cls._plan_for(node, pspec_meta, model_size, reduce_size)
+            plans[name] = cls._plan_for(node, pspec_meta, mesh_axes)
         return cls(mesh_axes, plans)
 
     @staticmethod
-    def _plan_for(node, meta, model_size: int, reduce_size: int) -> ParamPlan:
+    def _plan_for(node, meta, mesh_axes) -> ParamPlan:
+        reduce_size = mesh_axes.get(const.MESH_AXIS_REDUCE, 1)
         if node is None:
             # No config for this param: replicate + implicit psum (safe default).
             return ParamPlan(name=meta.name, pspec=P(), opt_pspec=P(),
@@ -93,19 +95,24 @@ class ShardingPlan:
         partition_axis = None
         num_shards: Tuple[int, ...] = ()
         param_pspec = P()
+        partition_mesh_axis = const.MESH_AXIS_MODEL
         if node.HasField("partitioner"):
             num_shards = tuple(node.partitioner.num_shards)
             active = [i for i, k in enumerate(num_shards) if k > 1]
             if active:
                 partition_axis = active[0]
+            if node.partitioner.mesh_axis:
+                partition_mesh_axis = node.partitioner.mesh_axis
 
-        # Physical storage sharding: put the model axis on the partitioned tensor
+        # Physical storage sharding: put the target mesh axis ("model" for tensor
+        # parallelism, "expert" for expert parallelism) on the partitioned tensor
         # axis when the mesh has one and the dimension tiles evenly; otherwise the
         # parameter stays replicated and partitioning remains logical metadata.
-        if (partition_axis is not None and model_size > 1
-                and meta.shape[partition_axis] % model_size == 0):
+        axis_size = mesh_axes.get(partition_mesh_axis, 1)
+        if (partition_axis is not None and axis_size > 1
+                and meta.shape[partition_axis] % axis_size == 0):
             spec_dims: list = [None] * len(meta.shape)
-            spec_dims[partition_axis] = const.MESH_AXIS_MODEL
+            spec_dims[partition_axis] = partition_mesh_axis
             param_pspec = P(*spec_dims)
 
         kind = node.WhichOneof("synchronizer")
@@ -123,13 +130,15 @@ class ShardingPlan:
             return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=opt_pspec,
                              sync=SYNC_PS, sparse=meta.sparse or node.sparse,
                              staleness=ps.staleness, synchronous=ps.sync,
-                             partition_axis=partition_axis, num_shards=num_shards)
+                             partition_axis=partition_axis, num_shards=num_shards,
+                             partition_mesh_axis=partition_mesh_axis)
 
         ar = sync_node.all_reduce_synchronizer
         return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=param_pspec,
                          sync=SYNC_ALLREDUCE, compressor=ar.compressor, group=ar.group,
                          sparse=meta.sparse or node.sparse,
-                         partition_axis=partition_axis, num_shards=num_shards)
+                         partition_axis=partition_axis, num_shards=num_shards,
+                         partition_mesh_axis=partition_mesh_axis)
 
     # -------------------------------------------------------------- accessors
     @property

@@ -207,6 +207,7 @@ class AsyncPSRunner(DistributedRunner):
         self._workers = {i: AsyncWorker(self, i) for i in range(self.num_workers)}
         self._dump_lock = threading.Lock()
         self._dumped = False
+        self._placer = None
         logging.info("AsyncPSRunner: %d worker(s), staleness=%s",
                      self.num_workers, self.staleness or "unbounded")
 
@@ -248,13 +249,19 @@ class AsyncPSRunner(DistributedRunner):
             raise ValueError(f"worker_id {worker_id} out of range [0, {self.num_workers})")
         return self._workers[worker_id]
 
+    def _place(self, state: TrainState) -> TrainState:
+        """Place a state onto the mesh with the service's shardings (jit cached
+        across calls so repeated adoption does not recompile)."""
+        if self._placer is None:
+            self._placer = jax.jit(lambda s: s, out_shardings=self._state_shardings)
+        with self.mesh:
+            return self._placer(state)
+
     def restore(self, state: TrainState):
         """Adopt a (checkpoint-restored) state as the service's."""
         if self.service is None:
             raise RuntimeError("Call init(params) before restore()")
-        place = jax.jit(lambda s: s, out_shardings=self._state_shardings)
-        with self.mesh:
-            self.service.reset(place(state))
+        self.service.reset(self._place(state))
 
     def _maybe_dump_async_graphs(self, params, sharded_batch, ef_state):
         """AUTODIST_DUMP_GRAPHS stage snapshots for the async regime (the sync
@@ -290,13 +297,7 @@ class AsyncPSRunner(DistributedRunner):
         if batch is None:
             state, batch = None, state
         if state is not None and self.service is not None:
-            place = jax.jit(lambda s: s, out_shardings=self._state_shardings)
-
-            def placer(s):
-                with self.mesh:
-                    return place(s)
-
-            self.service.adopt(state, placer)
+            self.service.adopt(state, self._place)
         fetched = self.worker(worker_id).step(batch, timeout=self.DEFAULT_STEP_TIMEOUT)
         return self.service.state, fetched
 

@@ -1,0 +1,90 @@
+"""Expert-parallel strategy: MoE expert weights sharded over the ``expert`` axis.
+
+Beyond reference parity (the reference's strategies cover data parallelism and
+per-variable placement only, SURVEY.md §2.2); this builder targets MoE models
+(``models/moe.py``). Parameters identified as expert-banked — leading dimension
+equal to ``num_experts`` and matching the ``expert_filter`` name test — get a
+partitioner on tensor axis 0 mapped onto the ``expert`` mesh axis, so each device
+stores only its experts and XLA inserts the dispatch/return ``all_to_all``s.
+Every other parameter falls back to AllReduce data parallelism (replicated +
+implicit gradient psum).
+"""
+
+from typing import Callable, Optional
+
+from autodist_tpu import const
+from autodist_tpu.model_spec import ModelSpec
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.all_reduce_strategy import parse_ar_options
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+
+
+def _default_expert_filter(name: str) -> bool:
+    return "expert" in name.lower()
+
+
+class ExpertParallel(StrategyBuilder):
+    """AllReduce everywhere + expert-axis sharding for expert-banked parameters.
+
+    ``expert_axis_size`` sizes the mesh ``expert`` axis (-1 = one expert shard per
+    device group; must divide both the device count and ``num_experts``); the
+    remaining devices fill the ``data`` axis.
+    """
+
+    def __init__(self, num_experts: int, expert_axis_size: int = -1,
+                 expert_filter: Optional[Callable[[str], bool]] = None,
+                 chunk_size: int = 128, all_reduce_spec: str = "AUTO",
+                 compressor: str = "NoneCompressor"):
+        if num_experts < 2:
+            raise ValueError("num_experts must be >= 2")
+        self._num_experts = num_experts
+        self._expert_axis_size = expert_axis_size
+        self._expert_filter = expert_filter or _default_expert_filter
+        self._chunk_size, self._spec, self._compressor = parse_ar_options(
+            chunk_size, all_reduce_spec, compressor)
+
+    def _resolve_expert_axis(self, resource_spec: ResourceSpec) -> int:
+        n = max(1, resource_spec.num_accelerators
+                or len(resource_spec.replica_devices))
+        size = self._expert_axis_size
+        if size == -1:
+            # Largest divisor of both the device count and the expert count: every
+            # expert shard holds num_experts/size whole experts.
+            size = next(s for s in range(min(n, self._num_experts), 0, -1)
+                        if n % s == 0 and self._num_experts % s == 0)
+        if n % size != 0:
+            raise ValueError(
+                f"expert_axis_size={size} does not divide {n} devices")
+        if self._num_experts % size != 0:
+            raise ValueError(
+                f"expert_axis_size={size} does not divide num_experts="
+                f"{self._num_experts}")
+        return size
+
+    def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
+        expert_size = self._resolve_expert_axis(resource_spec)
+        strategy = Strategy()
+        for i, spec in enumerate(model_spec.trainable.values()):
+            node = strategy.proto.node_config.add(var_name=spec.name)
+            node.sparse = spec.sparse
+            is_expert = (self._expert_filter(spec.name) and len(spec.shape) >= 1
+                         and spec.shape[0] == self._num_experts)
+            if is_expert:
+                node.partitioner.num_shards.extend(
+                    [expert_size] + [1] * (len(spec.shape) - 1))
+                node.partitioner.mesh_axis = const.MESH_AXIS_EXPERT
+                for k in range(expert_size):
+                    part = node.part_config.add(var_name=f"{spec.name}/part_{k}")
+                    ar = part.all_reduce_synchronizer
+                    ar.spec = self._spec
+                    ar.compressor = self._compressor
+                    ar.group = i // self._chunk_size
+            else:
+                ar = node.all_reduce_synchronizer
+                ar.spec = self._spec
+                ar.compressor = self._compressor
+                ar.group = i // self._chunk_size
+        axes = {const.MESH_AXIS_EXPERT: expert_size, const.MESH_AXIS_DATA: -1}
+        self._fill_mesh_config(strategy, resource_spec,
+                               self._resolved_axes(resource_spec, axes))
+        return strategy

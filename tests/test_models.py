@@ -157,11 +157,13 @@ def test_lstm_lm_sampled_softmax_trains_parallax():
 
 
 def test_lstm_lm_sampled_softmax_approximates_full_softmax():
-    # With every vocab id in the sampled set, sampled softmax == full softmax
-    # (accidental-hit masking removes the duplicated true class).
+    # With every vocab id in the sampled set and no importance correction,
+    # sampled softmax == full softmax (accidental-hit masking removes the
+    # duplicated true class).
     from autodist_tpu.models import lstm_lm
     cfg = lstm_lm.LSTMLMConfig(vocab_size=32, emb_dim=8, hidden_dim=16,
-                               n_layers=1, num_sampled=32, dtype=jnp.float32)
+                               n_layers=1, num_sampled=32, dtype=jnp.float32,
+                               subtract_log_q=False)
     model, params = lstm_lm.init_params(cfg)
     loss_fn = lstm_lm.make_loss_fn(model)
     batch = lstm_lm.synthetic_batch(cfg, batch_size=4, seq_len=8, sampled=False)
@@ -169,3 +171,42 @@ def test_lstm_lm_sampled_softmax_approximates_full_softmax():
     batch["neg_ids"] = np.arange(32, dtype=np.int32)
     sampled = float(loss_fn(params, batch))
     np.testing.assert_allclose(sampled, full, rtol=1e-5)
+
+
+def test_lstm_lm_log_q_correction_matches_manual():
+    # subtract_log_q shifts each logit by -log q(id) under the log-uniform
+    # sampler; verify against a hand-computed correction of the uncorrected loss.
+    import dataclasses as dc
+
+    from autodist_tpu.models import lstm_lm
+    cfg = lstm_lm.LSTMLMConfig(vocab_size=64, emb_dim=8, hidden_dim=16,
+                               n_layers=1, num_sampled=16, dtype=jnp.float32)
+    model, params = lstm_lm.init_params(cfg)
+    batch = lstm_lm.synthetic_batch(cfg, batch_size=2, seq_len=4)
+    corrected = float(lstm_lm.make_loss_fn(model)(params, batch))
+
+    plain_model = lstm_lm.LSTMLMWithHead(dc.replace(cfg, subtract_log_q=False))
+
+    def manual(params, batch):
+        tokens, neg_ids = batch["tokens"], batch["neg_ids"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        h = np.asarray(plain_model.apply({"params": params}, inputs),
+                       dtype=np.float32)
+        w = np.asarray(params["softmax_w"])
+        b = np.asarray(params["softmax_b"])
+
+        def log_q(ids):
+            q = (np.log(ids + 2.0) - np.log(ids + 1.0)) / np.log(cfg.vocab_size + 1)
+            return np.log(q)
+
+        true_logit = np.einsum("bth,bth->bt", h, w[targets]) + b[targets] \
+            - log_q(targets.astype(np.float64))
+        neg = np.einsum("bth,sh->bts", h, w[neg_ids]) + b[neg_ids] \
+            - log_q(neg_ids.astype(np.float64))[None, None, :]
+        neg = np.where(neg_ids[None, None, :] == targets[..., None], -1e9, neg)
+        all_logits = np.concatenate([true_logit[..., None], neg], axis=-1)
+        lse = np.log(np.exp(all_logits - all_logits.max(-1, keepdims=True))
+                     .sum(-1)) + all_logits.max(-1)
+        return float((-true_logit + lse).mean())
+
+    np.testing.assert_allclose(corrected, manual(params, batch), rtol=1e-4)
