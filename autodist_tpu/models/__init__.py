@@ -18,10 +18,12 @@ from autodist_tpu.models.densenet import DenseNet, DenseNet121Config
 from autodist_tpu.models.inception import InceptionV3, InceptionV3Config
 from autodist_tpu.models.lstm_lm import LSTMLMWithHead, LSTMLMConfig
 from autodist_tpu.models.moe import MoETransformerLM, MoETransformerLMConfig
+from autodist_tpu.models.pipeline_lm import PipelineLM, PipelineLMConfig
 
 __all__ = [
     "TransformerLM", "TransformerLMConfig", "ResNet", "ResNet50Config",
     "Bert", "BertConfig", "VGG16", "NeuMF", "NeuMFConfig",
     "DenseNet", "DenseNet121Config", "InceptionV3", "InceptionV3Config",
     "LSTMLMWithHead", "LSTMLMConfig", "MoETransformerLM", "MoETransformerLMConfig",
+    "PipelineLM", "PipelineLMConfig",
 ]

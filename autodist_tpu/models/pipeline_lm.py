@@ -1,0 +1,176 @@
+"""Pipeline-parallel Transformer LM over the mesh ``pipe`` axis.
+
+Beyond reference parity (the reference scoped pipeline parallelism out,
+``docs/design/architecture.rst:49-51``). The model is a pure-JAX functional
+transformer whose block weights are *stacked* along a leading layer dimension —
+the natural layout for pipelining on TPU: the ``Pipeline`` strategy shards that
+dimension ``P("pipe", ...)`` so each device stores (and runs) a contiguous group
+of layers, and the forward pass streams microbatches through
+``parallel/pipeline.pipeline_apply`` (GPipe schedule, ``lax.ppermute`` handoffs).
+Embedding, final norm, and LM head stay replicated across pipe ranks (cheap
+redundant compute in exchange for zero extra communication).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.parallel.pipeline import pipelined
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineLMConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_len: int = 1024
+    n_stages: int = 4
+    num_microbatches: int = 4
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        if self.n_layers % self.n_stages:
+            raise ValueError("n_layers must be divisible by n_stages")
+
+
+def _layer_norm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + 1e-6)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block_apply(p, x, config: PipelineLMConfig):
+    """One pre-LN transformer block; ``p`` holds this layer's weights (no layer dim)."""
+    cfg = config
+    b, t, d = x.shape
+    hd = d // cfg.n_heads
+
+    h = _layer_norm(x, p["ln1_s"], p["ln1_b"])
+    qkv = h @ p["wqkv"].astype(x.dtype)                      # [B, T, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, cfg.n_heads, hd)
+    k = k.reshape(b, t, cfg.n_heads, hd)
+    v = v.reshape(b, t, cfg.n_heads, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd).astype(np.float32)
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(causal, scores.astype(jnp.float32), -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, d)
+    x = x + ctx @ p["wo"].astype(x.dtype)
+
+    h = _layer_norm(x, p["ln2_s"], p["ln2_b"])
+    h = jax.nn.gelu(h @ p["w1"].astype(x.dtype))
+    return x + h @ p["w2"].astype(x.dtype)
+
+
+class PipelineLM:
+    """Functional model object: ``apply(params, tokens) -> logits``."""
+
+    def __init__(self, config: PipelineLMConfig):
+        self.config = config
+
+    def apply(self, params, tokens):
+        cfg = self.config
+        b, t = tokens.shape
+        m = cfg.num_microbatches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+
+        x = params["embed"][tokens].astype(cfg.dtype)
+        x = x + params["pos"][None, :t, :].astype(cfg.dtype)
+
+        # [B, T, D] -> [M, B/M, T, D]: split the batch into microbatches with the
+        # microbatch index outermost-within-batch so the data sharding stays on the
+        # per-microbatch batch dim.
+        x_mb = x.reshape(b // m, m, t, cfg.d_model).swapaxes(0, 1)
+
+        # [L, ...] block stacks -> [S, L/S, ...] stage groups (contiguous layers).
+        lps = cfg.n_layers // cfg.n_stages
+        stage_params = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_stages, lps, *a.shape[1:]), params["blocks"])
+
+        def stage_fn(p, xb):
+            p = jax.tree_util.tree_map(lambda a: a[0], p)  # drop stage shard dim
+            def body(carry, layer_p):
+                return _block_apply(layer_p, carry, cfg), None
+            out, _ = jax.lax.scan(body, xb, p)
+            return out
+
+        y_mb = pipelined(stage_fn, cfg.n_stages, axis=const.MESH_AXIS_PIPE)(
+            stage_params, x_mb)
+
+        h = y_mb.swapaxes(0, 1).reshape(b, t, cfg.d_model)
+        h = _layer_norm(h, params["ln_f_s"], params["ln_f_b"])
+        return h.astype(jnp.float32) @ params["head"]
+
+
+def make_loss_fn(model: PipelineLM):
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply(params, inputs)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    return loss_fn
+
+
+def init_params(config: PipelineLMConfig, rng: Optional[jax.Array] = None):
+    cfg = config
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    keys = jax.random.split(rng, 8)
+    d, f, l, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+
+    def normal(key, shape, scale):
+        return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
+
+    params = {
+        "embed": normal(keys[0], (v, d), 0.02),
+        "pos": normal(keys[1], (cfg.max_len, d), 0.02),
+        "blocks": {
+            "ln1_s": jnp.ones((l, d), jnp.float32),
+            "ln1_b": jnp.zeros((l, d), jnp.float32),
+            "wqkv": normal(keys[2], (l, d, 3 * d), d ** -0.5),
+            "wo": normal(keys[3], (l, d, d), d ** -0.5),
+            "ln2_s": jnp.ones((l, d), jnp.float32),
+            "ln2_b": jnp.zeros((l, d), jnp.float32),
+            "w1": normal(keys[4], (l, d, f), d ** -0.5),
+            "w2": normal(keys[5], (l, f, d), f ** -0.5),
+        },
+        "ln_f_s": jnp.ones((d,), jnp.float32),
+        "ln_f_b": jnp.zeros((d,), jnp.float32),
+        "head": normal(keys[6], (d, v), d ** -0.5),
+    }
+    return PipelineLM(cfg), params
+
+
+def sequential_apply(model: PipelineLM, params, tokens):
+    """Reference forward without the pipeline (for parity tests): same math, plain
+    layer loop."""
+    cfg = model.config
+    _, t = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = x + params["pos"][None, :t, :].astype(cfg.dtype)
+    for i in range(cfg.n_layers):
+        layer_p = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+        x = _block_apply(layer_p, x, cfg)
+    x = _layer_norm(x, params["ln_f_s"], params["ln_f_b"])
+    return x.astype(jnp.float32) @ params["head"]
+
+
+def synthetic_batch(config: PipelineLMConfig, batch_size: int, seq_len: int,
+                    seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {"tokens": rng.randint(0, config.vocab_size,
+                                  size=(batch_size, seq_len + 1)).astype(np.int32)}

@@ -1,0 +1,120 @@
+"""Pipeline parallelism over the mesh ``pipe`` axis (GPipe microbatch schedule).
+
+Beyond reference parity: the reference explicitly scoped pipeline parallelism out
+(``docs/design/architecture.rst:49-51``, SURVEY.md §2.2). The TPU-native design is
+the collective-permute formulation: stage parameters are sharded ``P("pipe", ...)``
+on their leading stage dimension, and inside a ``jax.shard_map`` manual region over
+the ``pipe`` axis each device runs its stage on a stream of microbatches, handing
+activations to the next stage with ``lax.ppermute``. The schedule is a single
+``lax.scan`` of ``num_microbatches + n_stages - 1`` ticks (fill + steady state +
+drain). Reverse-mode autodiff through the scan/ppermute yields the backward
+pipeline automatically — no hand-written backward schedule.
+
+The loop is written for the *partial-manual* shard_map mode (``axis_names=
+{"pipe"}``): every other mesh axis stays under automatic SPMD partitioning, so
+pipeline composes with data parallelism (batch stays sharded on ``data``) and the
+other strategies.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu import const
+
+PyTree = object
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x_mb: jax.Array,
+                   axis: str = const.MESH_AXIS_PIPE) -> jax.Array:
+    """GPipe loop body — must run inside a shard_map manual over ``axis``.
+
+    stage_fn(stage_params, x) -> y applies one pipeline stage to one microbatch
+    (``stage_params`` is this device's shard: leading stage dim of size 1).
+    x_mb: [num_microbatches, mb_batch, ...] activations entering stage 0,
+    replicated along ``axis`` (only rank 0 reads them; the transpose of that read
+    routes the input gradient back correctly). Returns the last stage's outputs,
+    [num_microbatches, mb_batch, ...], replicated along ``axis``.
+    """
+    n_stages = jax.lax.psum(1, axis)
+    rank = jax.lax.axis_index(axis)
+    n_mb = x_mb.shape[0]
+
+    if n_stages == 1:
+        # Degenerate single-stage pipeline: no schedule needed.
+        def apply_one(carry, x):
+            return carry, stage_fn(stage_params, x)
+        _, out = jax.lax.scan(apply_one, 0, x_mb)
+        return out
+
+    shift_pairs = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        mb = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False)
+        x = jnp.where(rank == 0, mb, state)
+        y = stage_fn(stage_params, x)
+        # The last stage starts emitting results at tick n_stages-1.
+        take = (t >= n_stages - 1) & (rank == n_stages - 1)
+        idx = jnp.clip(t - (n_stages - 1), 0, n_mb - 1)
+        prev = jax.lax.dynamic_index_in_dim(outputs, idx, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(take, y, prev), idx, 0)
+        nxt = jax.lax.ppermute(y, axis, shift_pairs)
+        return (nxt, outputs), None
+
+    init = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
+    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(n_mb + n_stages - 1))
+    # Broadcast the last stage's results to every pipe rank so downstream
+    # (replicated) computation — the LM head, the loss — sees them everywhere.
+    mask = (rank == n_stages - 1).astype(outputs.dtype)
+    return jax.lax.psum(outputs * mask, axis)
+
+
+def _ambient_mesh():
+    """The mesh in effect at trace time: the abstract-mesh context if set, else the
+    ``with mesh:`` physical-mesh context the runner steps under."""
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and not abstract.empty:
+        return abstract
+    from jax._src import mesh as mesh_lib  # no public accessor for `with mesh:`
+    physical = mesh_lib.thread_resources.env.physical_mesh
+    if physical is not None and not physical.empty:
+        return physical
+    raise RuntimeError(
+        "pipelined() needs a mesh: pass one explicitly or call inside a "
+        "`with mesh:` block (DistributedRunner.run steps under one)")
+
+
+def pipelined(stage_fn: Callable, n_stages: int, axis: str = const.MESH_AXIS_PIPE,
+              mesh=None) -> Callable:
+    """Wrap :func:`pipeline_apply` in the partial-manual shard_map.
+
+    Returns ``f(stage_params, x_mb) -> y_mb`` where ``stage_params`` leaves carry a
+    leading stage dimension of size ``n_stages`` (sharded over ``axis``) and all
+    other mesh axes remain automatic. ``mesh`` defaults to the ambient mesh
+    context (the runner steps inside ``with self.mesh``). Must run under ``jit``
+    (partial-manual shard_map is trace-time only).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def f(stage_params, x_mb):
+        m = mesh if mesh is not None else _ambient_mesh()
+        mesh_stages = dict(m.shape).get(axis, 1)
+        if mesh_stages != n_stages:
+            # Without this check a mismatched mesh silently runs only the stage
+            # groups the pipe axis covers — finite loss, most layers skipped.
+            raise ValueError(
+                f"pipelined(n_stages={n_stages}) needs mesh axis {axis!r} of that "
+                f"size, but the mesh has {axis}={mesh_stages}; size the mesh with "
+                f"the Pipeline strategy or a matching resource-spec mesh")
+        specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+        return jax.shard_map(
+            lambda p, x: pipeline_apply(stage_fn, p, x, axis=axis),
+            mesh=m, in_specs=(specs, P()), out_specs=P(),
+            axis_names={axis}, check_vma=False,
+        )(stage_params, x_mb)
+
+    return f

@@ -17,10 +17,11 @@ from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
 from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import RandomAxisPartitionAR
 from autodist_tpu.strategy.parallax_strategy import Parallax
 from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
+from autodist_tpu.strategy.pipeline_strategy import Pipeline
 
 __all__ = [
     "Strategy", "StrategyBuilder", "StrategyCompiler",
     "PS", "PSLoadBalancing", "byte_size_load_fn", "PartitionedPS",
     "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
-    "RandomAxisPartitionAR", "Parallax", "ExpertParallel",
+    "RandomAxisPartitionAR", "Parallax", "ExpertParallel", "Pipeline",
 ]

@@ -107,6 +107,40 @@ class StrategyBuilder(abc.ABC):
         n = max(1, resource_spec.num_accelerators or len(resource_spec.replica_devices))
         return dict(standard_mesh_shape(n, resource_spec.mesh_config or default_axes))
 
+    @staticmethod
+    def _build_axis0_sharded(model_spec: ModelSpec, resource_spec: ResourceSpec,
+                             mesh_axis: str, axis_size: int, param_filter,
+                             ar_spec, ar_compressor, chunk_size: int) -> Strategy:
+        """Shared skeleton for single-purpose axis builders (ExpertParallel,
+        Pipeline): parameters passing ``param_filter`` get a dim-0 partitioner of
+        ``axis_size`` shards mapped onto ``mesh_axis``; everything else gets an
+        AllReduce synchronizer. The mesh is {mesh_axis: axis_size, data: -1}
+        unless the resource spec overrides it."""
+        strategy = Strategy()
+        for i, spec in enumerate(model_spec.trainable.values()):
+            node = strategy.proto.node_config.add(var_name=spec.name)
+            node.sparse = spec.sparse
+
+            def fill_ar(cfg):
+                ar = cfg.all_reduce_synchronizer
+                ar.spec = ar_spec
+                ar.compressor = ar_compressor
+                ar.group = i // chunk_size
+
+            if param_filter(spec):
+                node.partitioner.num_shards.extend(
+                    [axis_size] + [1] * (len(spec.shape) - 1))
+                node.partitioner.mesh_axis = mesh_axis
+                for k in range(axis_size):
+                    fill_ar(node.part_config.add(var_name=f"{spec.name}/part_{k}"))
+            else:
+                fill_ar(node)
+        axes = {mesh_axis: axis_size, const.MESH_AXIS_DATA: -1}
+        StrategyBuilder._fill_mesh_config(
+            strategy, resource_spec,
+            StrategyBuilder._resolved_axes(resource_spec, axes))
+        return strategy
+
     # Shared helper: record the mesh shape + replica devices in the graph-level config.
     @staticmethod
     def _fill_mesh_config(strategy: Strategy, resource_spec: ResourceSpec,

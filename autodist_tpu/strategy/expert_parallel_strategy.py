@@ -63,28 +63,11 @@ class ExpertParallel(StrategyBuilder):
 
     def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
         expert_size = self._resolve_expert_axis(resource_spec)
-        strategy = Strategy()
-        for i, spec in enumerate(model_spec.trainable.values()):
-            node = strategy.proto.node_config.add(var_name=spec.name)
-            node.sparse = spec.sparse
-            is_expert = (self._expert_filter(spec.name) and len(spec.shape) >= 1
-                         and spec.shape[0] == self._num_experts)
-            if is_expert:
-                node.partitioner.num_shards.extend(
-                    [expert_size] + [1] * (len(spec.shape) - 1))
-                node.partitioner.mesh_axis = const.MESH_AXIS_EXPERT
-                for k in range(expert_size):
-                    part = node.part_config.add(var_name=f"{spec.name}/part_{k}")
-                    ar = part.all_reduce_synchronizer
-                    ar.spec = self._spec
-                    ar.compressor = self._compressor
-                    ar.group = i // self._chunk_size
-            else:
-                ar = node.all_reduce_synchronizer
-                ar.spec = self._spec
-                ar.compressor = self._compressor
-                ar.group = i // self._chunk_size
-        axes = {const.MESH_AXIS_EXPERT: expert_size, const.MESH_AXIS_DATA: -1}
-        self._fill_mesh_config(strategy, resource_spec,
-                               self._resolved_axes(resource_spec, axes))
-        return strategy
+
+        def is_expert(spec):
+            return (self._expert_filter(spec.name) and len(spec.shape) >= 1
+                    and spec.shape[0] == self._num_experts)
+
+        return self._build_axis0_sharded(
+            model_spec, resource_spec, const.MESH_AXIS_EXPERT, expert_size,
+            is_expert, self._spec, self._compressor, self._chunk_size)
