@@ -1,0 +1,5 @@
+"""Host data pipeline (native prefetch loader + device prefetch)."""
+
+from autodist_tpu.data.loader import DataLoader, device_prefetch
+
+__all__ = ["DataLoader", "device_prefetch"]

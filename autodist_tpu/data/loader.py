@@ -1,0 +1,200 @@
+"""Host data pipeline: native threaded prefetch with a pure-Python fallback.
+
+The reference delegated its input pipeline to TF's C++ runtime (queues,
+iterators, staging — SURVEY.md §2.4 "host data plane"); this module owns the
+equivalent native capability in-tree. ``DataLoader`` serves shuffled, fixed-size
+batches from in-memory arrays:
+
+- **Native path** (default): ``native/loader.cc`` is compiled once with g++ into
+  the working dir and driven via ctypes. A C++ worker thread reshuffles indices
+  per epoch and gathers rows into a prefetch ring off the GIL, so batch assembly
+  overlaps the TPU step.
+- **Fallback path**: the same semantics in numpy (used when no C++ toolchain is
+  available, and as the reference implementation in tests).
+
+``device_prefetch`` composes either path with the runner's feed remapping: it
+keeps ``prefetch`` batches in flight on-device (``shard_batch`` = device_put
+with the batch sharding) so host->HBM transfer also overlaps the step.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+_LIB_FAILED = False
+
+
+def _source_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "native", "loader.cc")
+
+
+def _build_native() -> Optional[ctypes.CDLL]:
+    """Compile and load the native loader; None when unavailable."""
+    global _LIB, _LIB_FAILED
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        src = _source_path()
+        try:
+            with open(src, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+            out_dir = os.path.join(const.DEFAULT_WORKING_DIR, "native")
+            os.makedirs(out_dir, exist_ok=True)
+            lib_path = os.path.join(out_dir, f"loader-{tag}.so")
+            if not os.path.exists(lib_path):
+                tmp = lib_path + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+                     src, "-lpthread"],
+                    check=True, capture_output=True)
+                os.replace(tmp, lib_path)  # atomic: concurrent builders race safely
+            lib = ctypes.CDLL(lib_path)
+            lib.dl_create.restype = ctypes.c_void_p
+            lib.dl_create.argtypes = [
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
+            lib.dl_next.restype = ctypes.c_int
+            lib.dl_next.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+            lib.dl_epochs_completed.restype = ctypes.c_uint64
+            lib.dl_epochs_completed.argtypes = [ctypes.c_void_p]
+            lib.dl_destroy.restype = None
+            lib.dl_destroy.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except Exception as e:  # no g++, sandboxed /tmp, ... -> numpy fallback
+            logging.warning("Native data loader unavailable (%s); "
+                            "using the numpy fallback", e)
+            _LIB_FAILED = True
+        return _LIB
+
+
+class DataLoader:
+    """Shuffled fixed-size batches over a dict of same-length arrays.
+
+    Continuous stream: iteration never ends (epochs reshuffle internally,
+    drop-last semantics — static batch shapes only, the TPU constraint).
+    ``native=None`` auto-selects; ``native=False`` forces the numpy fallback.
+    """
+
+    def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
+                 shuffle: bool = True, seed: int = 0, prefetch: int = 2,
+                 native: Optional[bool] = None):
+        if not arrays:
+            raise ValueError("DataLoader needs at least one array")
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"All arrays must share a leading dim, got {lengths}")
+        self._keys = list(arrays)
+        # C-contiguous row-major so a row is one contiguous memcpy.
+        self._arrays = {k: np.ascontiguousarray(v) for k, v in arrays.items()}
+        self.n_rows = next(iter(lengths.values()))
+        if batch_size < 1 or batch_size > self.n_rows:
+            raise ValueError(f"batch_size {batch_size} out of range "
+                             f"[1, {self.n_rows}]")
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = max(1, prefetch)
+
+        self._lib = _build_native() if native in (None, True) else None
+        if native is True and self._lib is None:
+            raise RuntimeError("native=True but the native loader failed to build")
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._create_native()
+            if not self._handle:
+                raise RuntimeError("dl_create rejected the loader configuration")
+        else:
+            self._rng = np.random.RandomState(seed)
+            self._perm = None
+            self._cursor = 0
+            self._epochs = 0
+
+    # ------------------------------------------------------------------ native
+    def _create_native(self):
+        n = len(self._keys)
+        ptrs = (ctypes.c_void_p * n)(
+            *[self._arrays[k].ctypes.data for k in self._keys])
+        row_bytes = (ctypes.c_uint64 * n)(
+            *[self._arrays[k].nbytes // self.n_rows for k in self._keys])
+        return self._lib.dl_create(
+            n, ptrs, row_bytes, self.n_rows, self.batch_size, self.prefetch,
+            int(self.shuffle), self.seed)
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def epochs_completed(self) -> int:
+        """Epoch wraps so far. Native path: producer-side (the prefetch worker
+        runs up to ``prefetch`` batches ahead of consumption, so this can read
+        ahead of what ``next()`` has returned). Fallback: consumer-side."""
+        if self._handle is not None:
+            return int(self._lib.dl_epochs_completed(self._handle))
+        return self._epochs
+
+    def next(self) -> Dict[str, np.ndarray]:
+        """The next batch (blocks on the prefetch ring in the native path)."""
+        out = {k: np.empty((self.batch_size,) + self._arrays[k].shape[1:],
+                           self._arrays[k].dtype) for k in self._keys}
+        if self._handle is not None:
+            ptrs = (ctypes.c_void_p * len(self._keys))(
+                *[out[k].ctypes.data for k in self._keys])
+            if self._lib.dl_next(self._handle, ptrs) != 0:
+                raise RuntimeError("Native loader was shut down")
+            return out
+        # numpy fallback: same drop-last/reshuffle-on-wrap semantics.
+        if self._perm is None or self.n_rows - self._cursor < self.batch_size:
+            if self._perm is not None:
+                self._epochs += 1
+            self._perm = (self._rng.permutation(self.n_rows) if self.shuffle
+                          else np.arange(self.n_rows))
+            self._cursor = 0
+        idx = self._perm[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        for k in self._keys:
+            out[k][...] = self._arrays[k][idx]
+        return out
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            yield self.next()
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def device_prefetch(loader: DataLoader, runner, depth: int = 2):
+    """Iterator of on-device sharded batches, ``depth`` transfers ahead.
+
+    ``runner.shard_batch`` is the feed remapping (split over data axes /
+    replicate); issuing it ahead of consumption overlaps host->HBM transfer with
+    the running step — the TPU analogue of the reference's staged input queues.
+    """
+    import collections
+    pending = collections.deque()
+    it = iter(loader)
+    while True:
+        while len(pending) < max(1, depth):
+            pending.append(runner.shard_batch(next(it)))
+        yield pending.popleft()

@@ -79,8 +79,13 @@ def _ambient_mesh():
     abstract = jax.sharding.get_abstract_mesh()
     if abstract is not None and not abstract.empty:
         return abstract
-    from jax._src import mesh as mesh_lib  # no public accessor for `with mesh:`
-    physical = mesh_lib.thread_resources.env.physical_mesh
+    try:
+        # No public accessor for the `with mesh:` context; degrade to the
+        # explicit-mesh error if a jax upgrade moves this.
+        from jax._src import mesh as mesh_lib
+        physical = mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        physical = None
     if physical is not None and not physical.empty:
         return physical
     raise RuntimeError(

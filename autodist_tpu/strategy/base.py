@@ -93,6 +93,14 @@ PS_DEFAULT_AXES = {const.MESH_AXIS_REDUCE: -1, const.MESH_AXIS_DATA: 1}
 AR_DEFAULT_AXES = {const.MESH_AXIS_DATA: -1}
 
 
+def num_devices(resource_spec: ResourceSpec) -> int:
+    """Device count a strategy targets: accelerators if the spec lists any, else
+    one slot per replica device, floor 1. Single source of truth for every
+    builder's divisibility checks and the recorded mesh."""
+    return max(1, resource_spec.num_accelerators
+               or len(resource_spec.replica_devices))
+
+
 class StrategyBuilder(abc.ABC):
     """Policy ABC: (ModelSpec, ResourceSpec) -> Strategy (reference base.py:102-117)."""
 
@@ -104,7 +112,7 @@ class StrategyBuilder(abc.ABC):
     def _resolved_axes(resource_spec: ResourceSpec, default_axes: dict) -> dict:
         """The full axis->size map this strategy will record — computed once per build
         so destination counts and the recorded mesh cannot drift apart."""
-        n = max(1, resource_spec.num_accelerators or len(resource_spec.replica_devices))
+        n = num_devices(resource_spec)
         return dict(standard_mesh_shape(n, resource_spec.mesh_config or default_axes))
 
     @staticmethod
@@ -145,7 +153,7 @@ class StrategyBuilder(abc.ABC):
     @staticmethod
     def _fill_mesh_config(strategy: Strategy, resource_spec: ResourceSpec,
                           axes: Optional[dict] = None):
-        n = max(1, resource_spec.num_accelerators or len(resource_spec.replica_devices))
+        n = num_devices(resource_spec)
         shape = standard_mesh_shape(n, axes if axes is not None else resource_spec.mesh_config)
         mc = strategy.proto.mesh_config
         del mc.axes[:]
@@ -187,8 +195,7 @@ class StrategyCompiler:
 
     def _resolve_mesh(self, strategy: Strategy):
         """Fill/validate mesh axis sizes against the actual device count."""
-        n = max(1, self._resource_spec.num_accelerators
-                or len(self._resource_spec.replica_devices))
+        n = num_devices(self._resource_spec)
         axes = {a.name: a.size for a in strategy.mesh_config.axes}
         shape = standard_mesh_shape(n, axes or None)
         mc = strategy.proto.mesh_config

@@ -16,7 +16,7 @@ from autodist_tpu import const
 from autodist_tpu.model_spec import ModelSpec
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.all_reduce_strategy import parse_ar_options
-from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder, num_devices
 
 
 def _default_expert_filter(name: str) -> bool:
@@ -44,8 +44,7 @@ class ExpertParallel(StrategyBuilder):
             chunk_size, all_reduce_spec, compressor)
 
     def _resolve_expert_axis(self, resource_spec: ResourceSpec) -> int:
-        n = max(1, resource_spec.num_accelerators
-                or len(resource_spec.replica_devices))
+        n = num_devices(resource_spec)
         size = self._expert_axis_size
         if size == -1:
             # Largest divisor of both the device count and the expert count: every

@@ -16,7 +16,7 @@ from autodist_tpu import const
 from autodist_tpu.model_spec import ModelSpec
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.all_reduce_strategy import parse_ar_options
-from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder, num_devices
 
 
 def _default_stage_filter(name: str) -> bool:
@@ -42,8 +42,7 @@ class Pipeline(StrategyBuilder):
             chunk_size, all_reduce_spec, compressor)
 
     def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
-        n = max(1, resource_spec.num_accelerators
-                or len(resource_spec.replica_devices))
+        n = num_devices(resource_spec)
         if n % self._n_stages != 0:
             raise ValueError(
                 f"n_stages={self._n_stages} does not divide {n} devices")

@@ -1,0 +1,93 @@
+"""Native + fallback data loader: batch semantics, shuffle, prefetch, device feed."""
+
+import numpy as np
+import pytest
+
+from autodist_tpu.data import DataLoader, device_prefetch
+
+
+def _dataset(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(n, 5).astype(np.float32),
+        "y": rng.randint(0, 10, size=(n,)).astype(np.int32),
+    }
+
+
+def test_native_loader_builds_and_serves_correct_rows():
+    data = _dataset()
+    dl = DataLoader(data, batch_size=16, shuffle=True, seed=3, native=True)
+    assert dl.is_native
+    row_lookup = {tuple(np.round(r, 5)): i for i, r in enumerate(data["x"])}
+    seen = set()
+    for _ in range(4):  # one epoch: 64/16 batches
+        batch = dl.next()
+        assert batch["x"].shape == (16, 5) and batch["y"].shape == (16,)
+        for bx, by in zip(batch["x"], batch["y"]):
+            i = row_lookup[tuple(np.round(bx, 5))]     # row exists in the dataset
+            assert data["y"][i] == by                  # arrays stay row-aligned
+            seen.add(i)
+    assert len(seen) == 64  # a full epoch covers every row exactly once
+    dl.close()
+
+
+def test_native_matches_fallback_semantics_unshuffled():
+    data = _dataset(n=20)
+    native = DataLoader(data, batch_size=8, shuffle=False, native=True)
+    fallback = DataLoader(data, batch_size=8, shuffle=False, native=False)
+    assert native.is_native and not fallback.is_native
+    for _ in range(5):  # crosses the drop-last boundary (20 = 2*8 + 4 dropped)
+        nb, fb = native.next(), fallback.next()
+        np.testing.assert_array_equal(nb["x"], fb["x"])
+        np.testing.assert_array_equal(nb["y"], fb["y"])
+    # Epoch counting: fallback counts consumed wraps exactly; the native counter
+    # is producer-side and may run up to `prefetch` batches ahead.
+    assert fallback.epochs_completed == 2
+    assert native.epochs_completed >= 2
+    native.close()
+
+
+def test_shuffle_is_seed_deterministic():
+    data = _dataset()
+    a = DataLoader(data, batch_size=16, shuffle=True, seed=7, native=True)
+    b = DataLoader(data, batch_size=16, shuffle=True, seed=7, native=True)
+    for _ in range(6):
+        np.testing.assert_array_equal(a.next()["x"], b.next()["x"])
+    a.close(), b.close()
+
+
+def test_loader_validates_inputs():
+    data = _dataset(n=8)
+    with pytest.raises(ValueError, match="batch_size"):
+        DataLoader(data, batch_size=9)
+    with pytest.raises(ValueError, match="leading dim"):
+        DataLoader({"x": np.zeros((4, 2)), "y": np.zeros((5,))}, batch_size=2)
+    with pytest.raises(ValueError, match="at least one"):
+        DataLoader({}, batch_size=1)
+
+
+def test_device_prefetch_feeds_training():
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.strategy import AllReduce
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(5, 1).astype(np.float32)
+    x = rng.randn(64, 5).astype(np.float32)
+    data = {"x": x, "y": (x @ w_true + 0.01 * rng.randn(64, 1)).astype(np.float32)}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"w": np.zeros((5, 1), np.float32)}
+    dl = DataLoader(data, batch_size=16, shuffle=True, seed=0)
+    ad = AutoDist(strategy_builder=AllReduce())
+    step = ad.function(loss_fn, params, optax.sgd(0.1),
+                       example_batch=dl.next())
+    feed = device_prefetch(dl, step.runner, depth=2)
+    losses = [float(step(next(feed))) for _ in range(20)]
+    assert losses[-1] < 0.1 * losses[0]
+    dl.close()
