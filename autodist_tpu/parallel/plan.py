@@ -37,6 +37,7 @@ SYNC_PS = "ps"
 COMP_NONE = strategy_pb2.AllReduceSynchronizer.NONE
 COMP_BF16 = strategy_pb2.AllReduceSynchronizer.BF16
 COMP_BF16_EF = strategy_pb2.AllReduceSynchronizer.BF16_EF
+COMP_POWER_SGD = strategy_pb2.AllReduceSynchronizer.POWER_SGD
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,6 +49,7 @@ class ParamPlan:
     opt_pspec: P                  # optimizer-state sharding (ZeRO shard for PS family)
     sync: str                     # SYNC_ALLREDUCE | SYNC_PS
     compressor: int = COMP_NONE   # strategy_pb2.AllReduceSynchronizer.Compressor
+    power_sgd_rank: int = 1       # approximation rank when compressor == POWER_SGD
     group: int = 0                # collective fusion hint
     sparse: bool = False
     staleness: int = 0
@@ -135,7 +137,8 @@ class ShardingPlan:
 
         ar = sync_node.all_reduce_synchronizer
         return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=param_pspec,
-                         sync=SYNC_ALLREDUCE, compressor=ar.compressor, group=ar.group,
+                         sync=SYNC_ALLREDUCE, compressor=ar.compressor,
+                         power_sgd_rank=max(1, ar.power_sgd_rank), group=ar.group,
                          sparse=meta.sparse or node.sparse,
                          partition_axis=partition_axis, num_shards=num_shards,
                          partition_mesh_axis=partition_mesh_axis)
