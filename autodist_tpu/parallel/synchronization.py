@@ -10,16 +10,22 @@ Reference counterparts:
 - ``kernel/synchronization/compressor.py``: ``NoneCompressor`` (:146-166),
   ``HorovodCompressor`` (:169-201, a dtype-cast codec) and ``HorovodCompressorEF``
   (:120-143, error feedback) map to NONE / BF16 / BF16_EF. ``PowerSGDCompressor``
-  — which the reference drafted but left disabled (:208-284) — is implemented and
-  working here as POWER_SGD: rank-r factorization M ~= P Q^T with one power
-  iteration per step, QR orthogonalization, and error feedback; only the [n, r]
-  and [m, r] factors cross the wire.
+  — which the reference drafted but left disabled (:208-284) — is implemented here
+  as POWER_SGD: rank-r factorization M ~= P Q^T with one power iteration per step
+  warm-started from the previous Q, QR orthogonalization, and error feedback; only
+  the [m, r] and [n, r] factors cross the wire.
+- Error-feedback residuals are **per data-parallel replica** (each worker keeps its
+  own residual in the reference, ``compressor.py:120-143``): they are stored with a
+  leading ``dp`` dimension sharded over the data axes, so in SPMD each device owns
+  exactly its own residual slice.
 - PS synchronizers need no explicit code here: weight-update sharding is expressed
   entirely through the plan's opt-state shardings (XLA emits the reduce-scatter /
   all-gather), replacing accumulators and token queues (``ps_synchronizer.py``).
 """
 
-from typing import Any, Callable, Dict, NamedTuple, Tuple
+import dataclasses
+import zlib
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,18 +40,42 @@ from autodist_tpu.parallel.plan import (COMP_BF16, COMP_BF16_EF, COMP_NONE,
 PyTree = Any
 
 
-class PowerSGDState(NamedTuple):
-    """Per-parameter PowerSGD carry: the EF residual and the reused Q factor
-    (warm-starting Q across steps is what makes one power iteration enough)."""
+@dataclasses.dataclass
+class EFState:
+    """Per-replica error-feedback residual for BF16_EF: ``error[i]`` is replica i's
+    residual (leading dim = dp size, sharded over the data axes)."""
 
-    error: jax.Array   # same shape as the parameter
-    q: jax.Array       # [prod(shape[1:]), rank]
+    error: jax.Array
+
+
+@dataclasses.dataclass
+class PowerSGDState:
+    """PowerSGD carry: per-replica EF residual plus the shared Q factor.
+
+    ``q`` is [n, r] and identical on every replica (it is rebuilt each step from the
+    pmean'd factor), so it stays replicated; warm-starting it across steps is what
+    makes one power iteration per step enough (reference draft compressor.py:208-284
+    kept ``rank`` + a persistent Q the same way).
+    """
+
+    error: jax.Array   # [dp, *param_shape]
+    q: jax.Array       # [n, r] where n = prod(param_shape[1:])
+
+
+jax.tree_util.register_dataclass(EFState, data_fields=["error"], meta_fields=[])
+jax.tree_util.register_dataclass(
+    PowerSGDState, data_fields=["error", "q"], meta_fields=[])
 
 
 def _powersgd_applies(shape) -> bool:
     # Like the reference draft, only matrix-shaped (rank >= 2) tensors are
     # factorized; vectors/scalars all-reduce exactly.
     return len(shape) >= 2
+
+
+def _powersgd_rank(shape, rank: int) -> int:
+    m, n = shape[0], int(np.prod(shape[1:]))
+    return max(1, min(rank, m, n))
 
 
 # --------------------------------------------------------------------- compressors
@@ -58,6 +88,34 @@ def compress(x: jax.Array, kind: int) -> jax.Array:
 
 def decompress(x: jax.Array, dtype) -> jax.Array:
     return x.astype(dtype)
+
+
+class _SyncResult:
+    """One parameter's synchronized gradient + its new compressor state. A plain
+    (non-pytree) object so a tree of these keeps the parameter-tree structure."""
+
+    __slots__ = ("synced", "state")
+
+    def __init__(self, synced, state):
+        self.synced = synced
+        self.state = state
+
+
+def _powersgd_sync(g: jax.Array, ef: PowerSGDState) -> _SyncResult:
+    """One PowerSGD round inside shard_map: M = g + e; P = pmean(M Q); P_hat = QR(P);
+    Q' = pmean(M^T P_hat); synced = P_hat Q'^T; e' = M - synced (local)."""
+    shape = g.shape
+    m, n = shape[0], int(np.prod(shape[1:]))
+    err = ef.error[0]                               # this replica's residual slice
+    mat = (g + err).reshape(m, n).astype(jnp.float32)
+    p_fac = jax.lax.pmean(mat @ ef.q, plan_lib.DP_AXES)          # [m, r] on the wire
+    p_hat, _ = jnp.linalg.qr(p_fac)                              # orthonormal [m, r]
+    q_new = jax.lax.pmean(mat.T @ p_hat, plan_lib.DP_AXES)       # [n, r] on the wire
+    approx = p_hat @ q_new.T                                     # identical everywhere
+    new_err = (mat - approx).reshape(shape).astype(g.dtype)
+    synced = approx.reshape(shape).astype(g.dtype)
+    return _SyncResult(synced, PowerSGDState(error=new_err[None],
+                                             q=q_new.astype(ef.q.dtype)))
 
 
 # ------------------------------------------------------------------ grad functions
@@ -73,9 +131,9 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
       all-reduce (and, with sharded opt state, the reduce-scatter) itself.
     - **Explicit** (some parameter has a compressor): ``jax.shard_map`` over the data
       axes — each shard computes a local gradient, compresses, ``lax.pmean``s the
-      compressed payload so the wire format is bfloat16, then decompresses. Error
-      feedback keeps a residual per parameter: x = g + ef; send compress(x);
-      ef' = x - decompress(compress(x)).
+      compressed payload so the wire format is bfloat16 (or the PowerSGD factors),
+      then decompresses. Error feedback keeps a per-replica residual: x = g + ef;
+      send compress(x); ef' = x - decompress(compress(x)).
     """
     if not sharding_plan.has_compression:
         def implicit(params, batch, ef_state):
@@ -94,8 +152,7 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
             "are not supported in one strategy")
 
     from autodist_tpu.model_spec import _path_name as name_of
-    comp_by_name: Dict[str, int] = {n: p.compressor
-                                    for n, p in sharding_plan.params.items()}
+    plans_by_name = dict(sharding_plan.params)
 
     def local_fn(params, batch, ef_state):
         if has_aux:
@@ -104,23 +161,32 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             aux = ()
 
-        def synced_leaf(path, g, ef):
-            kind = comp_by_name.get(name_of(path), COMP_NONE)
-            if kind == COMP_NONE:
-                return jax.lax.pmean(g, plan_lib.DP_AXES)
-            payload = compress(g + ef, kind) if kind == COMP_BF16_EF else compress(g, kind)
-            return decompress(jax.lax.pmean(payload, plan_lib.DP_AXES), g.dtype)
+        def sync_leaf(path, g, ef):
+            param_plan = plans_by_name.get(name_of(path))
+            kind = param_plan.compressor if param_plan else COMP_NONE
+            if kind == COMP_POWER_SGD and isinstance(ef, PowerSGDState):
+                return _powersgd_sync(g, ef)
+            if kind == COMP_BF16_EF and isinstance(ef, EFState):
+                x = g + ef.error[0]
+                synced = decompress(jax.lax.pmean(compress(x, kind), plan_lib.DP_AXES),
+                                    g.dtype)
+                new_err = x - decompress(compress(x, kind), g.dtype)
+                return _SyncResult(synced, EFState(error=new_err[None]))
+            if kind == COMP_BF16_EF:
+                raise TypeError(
+                    f"BF16_EF parameter {name_of(path)!r} has no EFState "
+                    f"(got {type(ef).__name__}); init_ef_state was bypassed")
+            if kind == COMP_BF16:
+                # Plain cast codec, reference HorovodCompressor semantics.
+                synced = decompress(jax.lax.pmean(compress(g, COMP_BF16),
+                                                  plan_lib.DP_AXES), g.dtype)
+                return _SyncResult(synced, ef)
+            # NONE, or POWER_SGD on a vector/scalar: exact all-reduce.
+            return _SyncResult(jax.lax.pmean(g, plan_lib.DP_AXES), ef)
 
-        def ef_leaf(path, g, ef):
-            kind = comp_by_name.get(name_of(path), COMP_NONE)
-            if kind != COMP_BF16_EF:
-                return ef
-            # Error feedback: x = g + ef; send compress(x); keep the residual.
-            x = g + ef
-            return x - decompress(compress(x, kind), g.dtype)
-
-        synced = jax.tree_util.tree_map_with_path(synced_leaf, grads, ef_state)
-        new_ef = jax.tree_util.tree_map_with_path(ef_leaf, grads, ef_state)
+        results = jax.tree_util.tree_map_with_path(sync_leaf, grads, ef_state)
+        synced = jax.tree_util.tree_map(lambda r: r.synced, results)
+        new_ef = jax.tree_util.tree_map(lambda r: r.state, results)
         loss = jax.lax.pmean(loss, plan_lib.DP_AXES)
         aux = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, plan_lib.DP_AXES), aux)
         return synced, loss, aux, new_ef
@@ -130,7 +196,7 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
     def explicit(params, batch, ef_state):
         batch_specs = jax.tree_util.tree_map(batch_spec_fn, batch)
         replicated = jax.tree_util.tree_map(lambda _: P(), params)
-        ef_specs = jax.tree_util.tree_map(lambda _: P(), ef_state)
+        ef_specs = ef_partition_specs(ef_state)
         out = jax.shard_map(
             local_fn, mesh=mesh,
             in_specs=(replicated, batch_specs, ef_specs),
@@ -154,19 +220,67 @@ def _batch_spec_maker(sharding_plan: ShardingPlan):
     return spec_for
 
 
-def init_ef_state(sharding_plan: ShardingPlan, params: PyTree) -> PyTree:
-    """Zeros for every parameter using error feedback; 0-size scalars otherwise.
+# ------------------------------------------------------------- compressor state
 
-    Shaped like ``params`` so it can ride the same sharding derivation. (Reference
-    kept the EF residual as Python-side state inside the compressor object,
-    ``compressor.py:120-143``; functionally it belongs in the train state.)
-    """
-    names = {n for n, p in sharding_plan.params.items() if p.compressor == COMP_BF16_EF}
+def init_ef_state(sharding_plan: ShardingPlan, params: PyTree,
+                  mesh: Optional[Mesh] = None) -> PyTree:
+    """Compressor state tree, shaped like ``params`` at the top level: an
+    :class:`EFState` for BF16_EF parameters, a :class:`PowerSGDState` for matrix
+    POWER_SGD parameters, and 0-d zeros elsewhere (so the tree rides the same
+    sharding derivation). Residuals carry a leading ``dp`` dimension — one slice per
+    data-parallel replica (the reference kept the residual as per-worker Python
+    state inside the compressor object, ``compressor.py:120-143``).
+
+    With ``mesh``, the residuals are allocated directly with their sharding (a
+    ``[dp, ...]`` residual materialized replicated first would cost dp× parameter
+    memory on one device — exactly the scale compression targets)."""
     from autodist_tpu.model_spec import _path_name
+    dp = sharding_plan.dp_size
+    plans = sharding_plan.params
 
     def leaf(path, x):
-        if _path_name(path) in names:
-            return jnp.zeros_like(x)
+        param_plan = plans.get(_path_name(path))
+        kind = param_plan.compressor if param_plan else COMP_NONE
+        if kind == COMP_BF16_EF:
+            return EFState(error=jnp.zeros((dp,) + x.shape, dtype=x.dtype))
+        if kind == COMP_POWER_SGD and _powersgd_applies(x.shape):
+            r = _powersgd_rank(x.shape, param_plan.power_sgd_rank)
+            n = int(np.prod(x.shape[1:]))
+            # Deterministic orthonormal warm start, seeded by the parameter name so
+            # every process initializes identically without coordination.
+            key = jax.random.PRNGKey(zlib.crc32(param_plan.name.encode()))
+            q0, _ = jnp.linalg.qr(jax.random.normal(key, (n, r), jnp.float32))
+            return PowerSGDState(error=jnp.zeros((dp,) + x.shape, dtype=x.dtype), q=q0)
         return jnp.zeros((), dtype=x.dtype)
 
-    return jax.tree_util.tree_map_with_path(leaf, params)
+    def build(p):
+        return jax.tree_util.tree_map_with_path(leaf, p)
+
+    if mesh is None:
+        return build(params)
+    abstract = jax.eval_shape(build, params)
+    shardings = ef_sharding_tree(mesh, abstract)
+    with mesh:
+        return jax.jit(build, out_shardings=shardings)(params)
+
+
+def ef_partition_specs(ef_state: PyTree) -> PyTree:
+    """PartitionSpecs for a compressor-state tree: ``error`` leaves shard their
+    leading (replica) dim over the data axes; everything else replicates."""
+
+    def spec(path, x):
+        last = path[-1] if path else None
+        if (isinstance(last, jax.tree_util.GetAttrKey) and last.name == "error"
+                and getattr(x, "ndim", 0) >= 1):
+            return P(plan_lib.DP_AXES, *([None] * (x.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, ef_state)
+
+
+def ef_sharding_tree(mesh: Mesh, ef_state: PyTree) -> PyTree:
+    """NamedSharding pytree for the compressor state (used for jit in/out shardings)."""
+    from jax.sharding import NamedSharding
+    specs = ef_partition_specs(ef_state)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda x: isinstance(x, P))

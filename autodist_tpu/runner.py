@@ -91,10 +91,10 @@ class DistributedRunner:
         """Place initial state onto the mesh (reference ran initializers at session
         construction, runner.py:97-100)."""
         opt_state = self._optimizer.init(params)
-        ef_state = synchronization.init_ef_state(self.plan, params)
+        ef_state = synchronization.init_ef_state(self.plan, params, mesh=self.mesh)
         p_sh = self.plan.param_sharding_tree(self.mesh, params)
         o_sh = self.plan.opt_sharding_tree(self.mesh, opt_state)
-        e_sh = jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()), ef_state)
+        e_sh = synchronization.ef_sharding_tree(self.mesh, ef_state)
         self._state_shardings = TrainState(
             step=NamedSharding(self.mesh, P()), params=p_sh, opt_state=o_sh, ef_state=e_sh)
         state = TrainState(step=np.zeros((), np.int32), params=params,

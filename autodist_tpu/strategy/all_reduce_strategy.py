@@ -26,10 +26,14 @@ _COMPRESSORS = {
     "NoneCompressor": strategy_pb2.AllReduceSynchronizer.NONE,
     "HorovodCompressor": strategy_pb2.AllReduceSynchronizer.BF16,
     "HorovodCompressorEF": strategy_pb2.AllReduceSynchronizer.BF16_EF,
+    # The reference drafted PowerSGDCompressor but shipped it disabled
+    # (compressor.py:208-284); here it is implemented (parallel/synchronization.py).
+    "PowerSGDCompressor": strategy_pb2.AllReduceSynchronizer.POWER_SGD,
     # TPU-native spellings.
     "none": strategy_pb2.AllReduceSynchronizer.NONE,
     "bf16": strategy_pb2.AllReduceSynchronizer.BF16,
     "bf16_ef": strategy_pb2.AllReduceSynchronizer.BF16_EF,
+    "power_sgd": strategy_pb2.AllReduceSynchronizer.POWER_SGD,
 }
 
 
@@ -46,9 +50,12 @@ def parse_ar_options(chunk_size: int, all_reduce_spec: str, compressor: str):
 
 class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor", power_sgd_rank: int = 2):
         self._chunk_size, self._spec, self._compressor = parse_ar_options(
             chunk_size, all_reduce_spec, compressor)
+        if power_sgd_rank < 1:
+            raise ValueError("power_sgd_rank must be >= 1")
+        self._power_sgd_rank = power_sgd_rank
 
     def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
         strategy = Strategy()
@@ -58,6 +65,8 @@ class AllReduce(StrategyBuilder):
             ar = node.all_reduce_synchronizer
             ar.spec = self._spec
             ar.compressor = self._compressor
+            if self._compressor == strategy_pb2.AllReduceSynchronizer.POWER_SGD:
+                ar.power_sgd_rank = self._power_sgd_rank
             ar.group = i // self._chunk_size
         self._fill_mesh_config(strategy, resource_spec,
                                self._resolved_axes(resource_spec, AR_DEFAULT_AXES))
