@@ -79,7 +79,8 @@ class Saver:
             flat.update({_OPT_PREFIX + k: v for k, v in
                          _flatten_named(state_or_params.opt_state).items()})
             flat.update({_EF_PREFIX + k: v for k, v in
-                         _flatten_named(state_or_params.ef_state).items()})
+                         _flatten_named(state_or_params.ef_state).items()
+                         if not _is_per_replica_residual(k)})
             step = int(np.asarray(jax.device_get(state_or_params.step)))
         else:
             flat.update(_flatten_named(state_or_params))
@@ -175,7 +176,8 @@ class Saver:
         else:
             opt_state = state.opt_state
         if ef_flat:
-            ef_state = _fill_template(state.ef_state, ef_flat, strict=False)
+            ef_state = _fill_template(state.ef_state, ef_flat, strict=False,
+                                      on_mismatch="reinit")
             ef_state = jax.device_put(
                 ef_state, jax.tree_util.tree_map(lambda l: l.sharding, state.ef_state))
         else:
@@ -185,9 +187,22 @@ class Saver:
                           opt_state=opt_state, ef_state=ef_state)
 
 
-def _fill_template(template: PyTree, flat: Dict[str, np.ndarray], strict: bool = True):
+def _is_per_replica_residual(name: str) -> bool:
+    """Per-replica [dp, ...] error-feedback residuals are transient worker-local
+    state (the reference kept them in-memory per worker, compressor.py:120-143):
+    checkpointing them would cost dp x parameter size and they cannot restore onto
+    a different topology anyway. Shape-stable compressor state (PowerSGD's Q) is
+    checkpointed."""
+    return name == "error" or name.endswith("/error")
+
+
+def _fill_template(template: PyTree, flat: Dict[str, np.ndarray], strict: bool = True,
+                   on_mismatch: str = "raise"):
     """Replace template leaves by name; leaves missing from the checkpoint are kept
-    (strict=False) or are an error (strict=True)."""
+    (strict=False) or are an error (strict=True). A shape mismatch raises
+    (``on_mismatch='raise'``) or keeps the template leaf with a warning
+    (``on_mismatch='reinit'`` — used for compressor state whose shapes depend on the
+    data-parallel topology)."""
     from autodist_tpu.model_spec import _path_name
 
     def fill(path, leaf):
@@ -195,6 +210,11 @@ def _fill_template(template: PyTree, flat: Dict[str, np.ndarray], strict: bool =
         if name in flat:
             value = flat[name]
             if tuple(value.shape) != tuple(getattr(leaf, "shape", value.shape)):
+                if on_mismatch == "reinit":
+                    logging.warning(
+                        "Reinitializing %s: saved shape %s does not match current %s "
+                        "(topology changed)", name, tuple(value.shape), tuple(leaf.shape))
+                    return leaf
                 raise ValueError(f"Checkpoint shape mismatch for {name}: "
                                  f"{value.shape} vs {leaf.shape}")
             return value

@@ -67,6 +67,16 @@ jax.tree_util.register_dataclass(
     PowerSGDState, data_fields=["error", "q"], meta_fields=[])
 
 
+def mesh_dp_size(mesh: Mesh) -> int:
+    """Actual data-parallel size of a mesh: product of the DP axes it carries.
+
+    The plan's ``dp_size`` reflects the device count the strategy was *built* for;
+    the runner may legally rebuild a smaller mesh when running on fewer local chips
+    (``DistributedRunner._mesh_from_plan``), so anything sized per-replica must use
+    the mesh the state actually lives on."""
+    return int(np.prod([mesh.shape[a] for a in plan_lib.DP_AXES if a in mesh.shape]))
+
+
 def _powersgd_applies(shape) -> bool:
     # Like the reference draft, only matrix-shaped (rank >= 2) tensors are
     # factorized; vectors/scalars all-reduce exactly.
@@ -166,6 +176,12 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
             kind = param_plan.compressor if param_plan else COMP_NONE
             if kind == COMP_POWER_SGD and isinstance(ef, PowerSGDState):
                 return _powersgd_sync(g, ef)
+            if kind == COMP_POWER_SGD and _powersgd_applies(g.shape):
+                # A matrix-shaped POWER_SGD param must carry a PowerSGDState; falling
+                # through would silently all-reduce the full gradient uncompressed.
+                raise TypeError(
+                    f"POWER_SGD parameter {name_of(path)!r} has no PowerSGDState "
+                    f"(got {type(ef).__name__}); init_ef_state was bypassed")
             if kind == COMP_BF16_EF and isinstance(ef, EFState):
                 x = g + ef.error[0]
                 synced = decompress(jax.lax.pmean(compress(x, kind), plan_lib.DP_AXES),
@@ -191,7 +207,7 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
         aux = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, plan_lib.DP_AXES), aux)
         return synced, loss, aux, new_ef
 
-    batch_spec_fn = _batch_spec_maker(sharding_plan)
+    batch_spec_fn = _batch_spec_maker(sharding_plan, dp=mesh_dp_size(mesh))
 
     def explicit(params, batch, ef_state):
         batch_specs = jax.tree_util.tree_map(batch_spec_fn, batch)
@@ -208,8 +224,7 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
     return explicit
 
 
-def _batch_spec_maker(sharding_plan: ShardingPlan):
-    dp = sharding_plan.dp_size
+def _batch_spec_maker(sharding_plan: ShardingPlan, dp: int):
 
     def spec_for(leaf):
         shape = getattr(leaf, "shape", ())
@@ -235,7 +250,7 @@ def init_ef_state(sharding_plan: ShardingPlan, params: PyTree,
     ``[dp, ...]`` residual materialized replicated first would cost dp× parameter
     memory on one device — exactly the scale compression targets)."""
     from autodist_tpu.model_spec import _path_name
-    dp = sharding_plan.dp_size
+    dp = mesh_dp_size(mesh) if mesh is not None else sharding_plan.dp_size
     plans = sharding_plan.params
 
     def leaf(path, x):
@@ -253,15 +268,21 @@ def init_ef_state(sharding_plan: ShardingPlan, params: PyTree,
             return PowerSGDState(error=jnp.zeros((dp,) + x.shape, dtype=x.dtype), q=q0)
         return jnp.zeros((), dtype=x.dtype)
 
-    def build(p):
-        return jax.tree_util.tree_map_with_path(leaf, p)
+    # Only shapes/dtypes matter: build from metadata so no parameter is ever
+    # transferred (a params operand would commit a fully-replicated copy of the
+    # model to every device before the plan's shardings are applied).
+    meta = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), params)
+
+    def build():
+        return jax.tree_util.tree_map_with_path(leaf, meta)
 
     if mesh is None:
-        return build(params)
-    abstract = jax.eval_shape(build, params)
+        return build()
+    abstract = jax.eval_shape(build)
     shardings = ef_sharding_tree(mesh, abstract)
     with mesh:
-        return jax.jit(build, out_shardings=shardings)(params)
+        return jax.jit(build, out_shardings=shardings)()
 
 
 def ef_partition_specs(ef_state: PyTree) -> PyTree:

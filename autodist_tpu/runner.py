@@ -131,7 +131,7 @@ class DistributedRunner:
         """Feed remapping: split batch leaves across data replicas, duplicate the
         rest (reference remapper.py:81-123 semantics, with the polymorphic dim now
         'leading dim divisible by dp_size')."""
-        dp = self.plan.dp_size
+        dp = synchronization.mesh_dp_size(self.mesh)
 
         def put(leaf):
             shape = getattr(leaf, "shape", None)

@@ -146,3 +146,49 @@ def test_init_ef_state_plain_params_no_compression():
 @pytest.mark.parametrize("name", ["PowerSGDCompressor", "power_sgd"])
 def test_builder_accepts_powersgd_spellings(name):
     AllReduce(compressor=name)
+
+
+def test_ef_state_sized_by_actual_mesh_not_plan():
+    """A strategy built for 8 devices can run on a smaller local mesh (the runner
+    rebuilds it, runner.py:_mesh_from_plan); residuals must be sized per the mesh the
+    state lives on, not the plan's original dp size."""
+    from autodist_tpu.parallel.mesh import build_mesh
+    from autodist_tpu.parallel.plan import ShardingPlan
+    from autodist_tpu.model_spec import ModelSpec
+    from autodist_tpu.runner import DistributedRunner
+
+    params = _params()
+    spec_model = ModelSpec(params)
+    strategy = AllReduce(compressor="PowerSGDCompressor").build(
+        spec_model, AutoDist().resource_spec)  # built for all 8 visible devices
+    plan = ShardingPlan.from_strategy(strategy, spec_model)
+    small_mesh = build_mesh(axes={"data": 4}, devices=jax.devices()[:4])
+    runner = DistributedRunner(strategy, spec_model, _loss, optax.sgd(0.05),
+                               mesh=small_mesh, plan=plan)
+    state = runner.init(params)
+    assert state.ef_state["w"].error.shape == (4, DIM_IN, DIM_OUT)
+    batch = _data()
+    state2, loss = runner.run(state, batch)
+    assert np.isfinite(float(loss))
+    assert state2.ef_state["w"].error.shape == (4, DIM_IN, DIM_OUT)
+
+
+def test_powersgd_matrix_without_state_raises():
+    """A matrix POWER_SGD param whose ef leaf is not a PowerSGDState must raise, not
+    silently fall back to uncompressed sync (mirror of the BF16_EF guard)."""
+    from autodist_tpu.parallel import synchronization
+    from autodist_tpu.parallel.plan import ShardingPlan
+    from autodist_tpu.model_spec import ModelSpec
+    from autodist_tpu.parallel.mesh import build_mesh
+
+    params = _params()
+    spec_model = ModelSpec(params)
+    strategy = AllReduce(compressor="PowerSGDCompressor").build(
+        spec_model, AutoDist().resource_spec)
+    plan = ShardingPlan.from_strategy(strategy, spec_model)
+    mesh = build_mesh(axes={"data": len(jax.devices())})
+    grad_fn = synchronization.make_grad_fn(plan, spec_model, mesh, _loss)
+    bad_ef = jax.tree_util.tree_map(
+        lambda _: jnp.zeros(()), params)  # bypassed init_ef_state
+    with pytest.raises(TypeError, match="PowerSGDState"):
+        grad_fn(params, _data(), bad_ef)
