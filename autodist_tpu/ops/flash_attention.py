@@ -1,18 +1,24 @@
-"""Flash attention — pallas TPU kernel (forward) with blockwise-JAX backward.
+"""Flash attention — pallas TPU kernels, forward AND backward.
 
 Forward: grid (batch*heads, q-blocks, k-blocks); each K/V block streams through
 VMEM via its own BlockSpec while VMEM scratch carries the online-softmax state
 (running max, denominator, unnormalized accumulator) across the k dimension of the
 grid — the [L, L] score matrix never exists, and resident VMEM is O(q_block +
 k_block), independent of sequence length. Causal upper-triangular blocks are
-skipped entirely (~2x fewer FLOPs).
+skipped entirely (~2x fewer FLOPs). The per-row logsumexp is emitted as a residual
+for the backward pass.
 
-Backward: ``jax.custom_vjp`` re-computes gradients with the differentiable
-blockwise-JAX implementation (:mod:`blockwise_attention`) under the same O(L*block)
-memory bound. (A dedicated pallas backward kernel is a further optimization, not a
-semantic change.)
+Backward (FlashAttention-2 style): scores are recomputed blockwise from the saved
+logsumexp, so nothing quadratic is ever materialized. Two kernels:
 
-On non-TPU backends the kernel runs in pallas interpret mode, so tests exercise
+- dK/dV: grid (batch*heads, k-blocks, q-blocks) — each k block accumulates
+  p^T dO and ds^T q across all its query blocks in VMEM scratch.
+- dQ:    grid (batch*heads, q-blocks, k-blocks) — each q block accumulates
+  ds k across its key blocks.
+
+The row term D_i = rowsum(dO * O) is precomputed in XLA (elementwise, fused).
+
+On non-TPU backends the kernels run in pallas interpret mode, so tests exercise
 the same code path on the CPU-sim mesh.
 """
 
@@ -24,14 +30,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from autodist_tpu.ops.blockwise_attention import NEG_INF
-from autodist_tpu.ops.blockwise_attention import blockwise_attention as _blockwise
 
-DEFAULT_Q_BLOCK = 128
-DEFAULT_K_BLOCK = 128
+# 512-blocks amortize grid/DMA overhead into MXU-sized matmuls: measured on a TPU
+# v5e chip (B=8 H=8 D=64, causal, fwd+bwd) flash@512 beats XLA's fused dot-product
+# attention at L>=2048 (10.1 vs 10.9 ms) and 1.5x at L=4096 (21.7 vs 32.5 ms),
+# while 128-blocks were 2.5x SLOWER than XLA. 1024 is faster still (16 ms at
+# L=4096) at higher VMEM pressure — worth passing explicitly for long context.
+DEFAULT_Q_BLOCK = 512
+DEFAULT_K_BLOCK = 512
 _LANES = 128  # scratch minor dim (TPU lane count)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                   lk: int, q_block: int, k_block: int, causal: bool, scale: float):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -50,11 +60,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-        k_blk = k_ref[0].astype(jnp.float32)              # [bk, d]
-        v_blk = v_ref[0].astype(jnp.float32)
+        # Matmul operands stay in the input dtype (bf16 runs the MXU at full rate);
+        # accumulation and softmax arithmetic are f32 via preferred_element_type.
+        q = q_ref[0]                                      # [bq, d]
+        k_blk = k_ref[0]                                  # [bk, d]
+        v_blk = v_ref[0]
         bq, bk = q.shape[0], k_blk.shape[0]
-        scores = jax.lax.dot_general(
+        scores = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [bq, bk]
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -73,16 +85,26 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                                     l_ref.shape)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_k - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        l_fin = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+        # Per-row logsumexp residual for the backward pass. Padding query rows get
+        # a finite lse too (zero-padded q still attends real keys); the backward is
+        # safe for them ONLY because dO is zero-padded there — do not rely on lse
+        # being NEG_INF for masked rows. Layout: [bh, n_q, bq] with the whole
+        # (n_q, bq) plane as one resident block (TPU tiling forbids a [1, bq]
+        # block); each q-block writes its row.
+        lse = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+        lse_ref[0, qi, :] = lse
 
 
 def _flash_forward(q, k, v, causal: bool, q_block: int, k_block: int,
                    interpret: bool):
+    """Returns (out [B, Lq, H, D], lse [B*H, n_q, bq] f32)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -104,7 +126,7 @@ def _flash_forward(q, k, v, causal: bool, q_block: int, k_block: int,
 
     kernel = functools.partial(_flash_kernel, lk=lk, q_block=bq, k_block=bk,
                                causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
         in_specs=[
@@ -112,8 +134,14 @@ def _flash_forward(q, k, v, causal: bool, q_block: int, k_block: int,
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, n_q, bq), lambda bh, i, j: (bh, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, n_q, bq), jnp.float32),
+        ),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),       # acc
             pltpu.VMEM((bq, _LANES), jnp.float32),  # running max
@@ -123,7 +151,182 @@ def _flash_forward(q, k, v, causal: bool, q_block: int, k_block: int,
     )(qf, kf, vf)
 
     out = out[:, :lq, :].reshape(b, h, lq, d).transpose(0, 2, 1, 3)
-    return out
+    return out, lse
+
+
+def _recompute_p_ds(q, do, k_blk, v_blk, lse, dd, q_start, k_start, lk, causal,
+                    scale):
+    """Shared backward block math: p [bq, bk] and ds (pre-scale) from a recomputed
+    score block. Matmul operands keep the input dtype (MXU rate); p/ds are f32."""
+    bq, bk = q.shape[0], k_blk.shape[0]
+    scores = scale * jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    invalid = k_pos >= lk
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        invalid = invalid | (k_pos > q_pos)
+    p = jnp.where(invalid, 0.0, jnp.exp(scores - lse))            # [bq, bk]
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [bq, bk]
+    ds = p * (dp - dd)
+    return p, ds
+
+
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *,
+                           lk: int, q_block: int, k_block: int, causal: bool,
+                           scale: float):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * q_block
+    k_start = ki * k_block
+    needed = (k_start <= q_start + q_block - 1) if causal else True
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        lse = lse_ref[0, qi, :][:, None]                  # [bq, 1]
+        dd = dd_ref[0, qi, :][:, None]
+        p, ds = _recompute_p_ds(q, do, k_blk, v_blk, lse, dd, q_start, k_start,
+                                lk, causal, scale)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                         dq_ref, dq_acc, *,
+                         lk: int, q_block: int, k_block: int, causal: bool,
+                         scale: float):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * q_block
+    k_start = ki * k_block
+    needed = (k_start <= q_start + q_block - 1) if causal else True
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        lse = lse_ref[0, qi, :][:, None]
+        dd = dd_ref[0, qi, :][:, None]
+        _, ds = _recompute_p_ds(q, do, k_blk, v_blk, lse, dd, q_start, k_start,
+                                lk, causal, scale)
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, q_block, k_block, interpret):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    dof = g.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    of = o.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+
+    # D_i = rowsum(dO * O) — elementwise, XLA fuses it.
+    dd = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+    bq = min(q_block, lq)
+    n_q = pl.cdiv(lq, bq)
+    q_pad = n_q * bq - lq
+    if q_pad:
+        qf = jnp.pad(qf, ((0, 0), (0, q_pad), (0, 0)))
+        dof = jnp.pad(dof, ((0, 0), (0, q_pad), (0, 0)))   # zero dO kills pad rows
+        dd = jnp.pad(dd, ((0, 0), (0, q_pad)))
+    dd = dd.reshape(b * h, n_q, bq)                        # lse's [bh, n_q, bq] layout
+    bk = min(k_block, lk)
+    n_k = pl.cdiv(lk, bk)
+    k_pad = n_k * bk - lk
+    if k_pad:
+        kf = jnp.pad(kf, ((0, 0), (0, k_pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, k_pad), (0, 0)))
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((1, n_q, bq), lambda bh, i, j: (bh, 0, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, i, 0))
+
+    dkdv_kernel = functools.partial(
+        _flash_bwd_dkdv_kernel, lk=lk, q_block=bq, k_block=bk, causal=causal,
+        scale=scale)
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(b * h, n_k, n_q),
+        in_specs=[q_spec, q_spec, row_spec, row_spec, kv_spec, kv_spec],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, n_k * bk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, n_k * bk, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, dof, lse, dd, kf, vf)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, lk=lk, q_block=bq, k_block=bk, causal=causal,
+        scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, n_q, bq), lambda bh, i, j: (bh, 0, 0)),
+            pl.BlockSpec((1, n_q, bq), lambda bh, i, j: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, n_q * bq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, dof, lse, dd, kf, vf)
+
+    dq = dq[:, :lq, :].reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    dk = dk[:, :lk, :].reshape(b, h, lk, d).transpose(0, 2, 1, 3)
+    dv = dv[:, :lk, :].reshape(b, h, lk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
 def _use_interpret() -> bool:
@@ -134,21 +337,19 @@ def _use_interpret() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, q_block, k_block):
-    return _flash_forward(q, k, v, causal, q_block, k_block, _use_interpret())
+    out, _ = _flash_forward(q, k, v, causal, q_block, k_block, _use_interpret())
+    return out
 
 
 def _flash_fwd(q, k, v, causal, q_block, k_block):
-    return _flash(q, k, v, causal, q_block, k_block), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, q_block, k_block, _use_interpret())
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, q_block, k_block, residuals, g):
-    q, k, v = residuals
-
-    def ref(q, k, v):
-        return _blockwise(q, k, v, causal=causal, block_size=k_block)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_backward(q, k, v, o, lse, g, causal, q_block, k_block,
+                           _use_interpret())
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -157,5 +358,5 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, q_block: int = DEFAULT_Q_BLOCK,
                     k_block: int = DEFAULT_K_BLOCK) -> jax.Array:
-    """Flash attention over [B, L, H, D] tensors (pallas forward, blockwise bwd)."""
+    """Flash attention over [B, L, H, D] tensors (pallas forward and backward)."""
     return _flash(q, k, v, causal, q_block, k_block)
