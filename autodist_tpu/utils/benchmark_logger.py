@@ -1,0 +1,162 @@
+"""Structured benchmark logging.
+
+Counterpart of the reference's benchmark logging stack
+(``examples/benchmark/utils/logs/logger.py:108-223``: ``BaseBenchmarkLogger`` /
+``BenchmarkFileLogger`` / ``BenchmarkBigQueryLogger``, and
+``utils/logs/mlperf_helper.py``'s compliance tags). Promoted into the framework so
+every example/benchmark shares one implementation (the reference kept it under
+examples).
+
+- :class:`BaseBenchmarkLogger` prints structured metrics through the framework
+  logger.
+- :class:`BenchmarkFileLogger` appends one JSON object per line to
+  ``metric.log`` / ``benchmark_run.log`` under a directory (the reference's file
+  format: name/value/unit/global_step/timestamp/extras).
+- :func:`log_run_info` captures the run's environment (platform, device count,
+  jax version, model/dataset/strategy names) like the reference's
+  ``gather_run_info``.
+- :func:`mlperf_log` emits ``:::MLL``-style compliance lines (reference
+  ``mlperf_helper.py`` wrapped the mlperf_compliance package; the tag format here
+  follows the public MLPerf logging convention so existing scrapers parse it).
+
+The reference's BigQuery sink needs network egress; here any configured
+``AUTODIST_BENCHMARK_LOG_DIR`` selects the file sink and the base logger is the
+fallback, which is the same graceful degradation the reference used when the
+bigquery client was absent.
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from autodist_tpu.utils import logging
+
+METRIC_LOG_FILE_NAME = "metric.log"
+BENCHMARK_RUN_LOG_FILE_NAME = "benchmark_run.log"
+
+
+class BaseBenchmarkLogger:
+    """Log metrics through the framework logger (reference logger.py:108-140)."""
+
+    def log_metric(self, name: str, value: float, unit: Optional[str] = None,
+                   global_step: Optional[int] = None,
+                   extras: Optional[Dict[str, Any]] = None):
+        metric = _metric_dict(name, value, unit, global_step, extras)
+        if metric is not None:
+            logging.info("Benchmark metric: %s", metric)
+
+    def log_run_info(self, run_info: Dict[str, Any]):
+        logging.info("Benchmark run: %s", run_info)
+
+    def on_finish(self, status: str = "success"):
+        pass
+
+
+class BenchmarkFileLogger(BaseBenchmarkLogger):
+    """Append metrics as JSON lines under ``logging_dir``
+    (reference logger.py:142-185)."""
+
+    def __init__(self, logging_dir: str):
+        self._logging_dir = logging_dir
+        os.makedirs(logging_dir, exist_ok=True)
+        self._metric_file = open(
+            os.path.join(logging_dir, METRIC_LOG_FILE_NAME), "a")
+
+    def log_metric(self, name, value, unit=None, global_step=None, extras=None):
+        metric = _metric_dict(name, value, unit, global_step, extras)
+        if metric is not None:
+            self._metric_file.write(json.dumps(metric, sort_keys=True) + "\n")
+            self._metric_file.flush()
+
+    def log_run_info(self, run_info: Dict[str, Any]):
+        path = os.path.join(self._logging_dir, BENCHMARK_RUN_LOG_FILE_NAME)
+        with open(path, "a") as f:
+            f.write(json.dumps(run_info, sort_keys=True, default=str) + "\n")
+
+    def on_finish(self, status: str = "success"):
+        self.log_metric("run_status", 1.0 if status == "success" else 0.0,
+                        extras={"status": status})
+        self._metric_file.close()
+
+
+def get_benchmark_logger() -> BaseBenchmarkLogger:
+    """File logger when AUTODIST_BENCHMARK_LOG_DIR is set, else the base logger
+    (the reference selected its sink from flags the same way)."""
+    log_dir = os.environ.get("AUTODIST_BENCHMARK_LOG_DIR", "")
+    if log_dir:
+        return BenchmarkFileLogger(log_dir)
+    return BaseBenchmarkLogger()
+
+
+def gather_run_info(model_name: str, dataset_name: str = "synthetic",
+                    strategy_name: str = "", batch_size: int = 0) -> Dict[str, Any]:
+    """Environment + run metadata (reference logger.py:226-260 gathered TF/CUDA
+    versions and machine config; here: jax version, platform, device inventory)."""
+    import jax
+    devices = jax.devices()
+    info = {
+        "model_name": model_name,
+        "dataset": {"name": dataset_name},
+        "strategy": strategy_name,
+        "batch_size": batch_size,
+        "run_date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "machine_config": {
+            "platform": devices[0].platform if devices else "none",
+            "num_devices": len(devices),
+            "device_kinds": sorted({getattr(d, "device_kind", "?") for d in devices}),
+        },
+    }
+    try:
+        info["jax_version"] = jax.__version__
+    except AttributeError:
+        pass
+    return info
+
+
+def _metric_dict(name, value, unit, global_step, extras) -> Optional[Dict[str, Any]]:
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        logging.warning("Metric %s has non-numeric value %r; dropped", name, value)
+        return None
+    import datetime
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    return {
+        "name": name,
+        "value": value,
+        "unit": unit,
+        "global_step": global_step,
+        "timestamp": ts,
+        "extras": extras or {},
+    }
+
+
+# ------------------------------------------------------------------- MLPerf
+
+_MLPERF_DEFAULT_VERSION = "4.0.0"
+
+
+def mlperf_log(key: str, value: Any = None, *, kind: str = "POINT_IN_TIME",
+               version: str = _MLPERF_DEFAULT_VERSION,
+               out: Optional[List[str]] = None) -> str:
+    """Emit one MLPerf-compliance log line (reference mlperf_helper.py wrapped
+    mlperf_compliance.mlperf_log; the ``:::MLL`` format is the public convention).
+
+    Returns the formatted line; appends to ``out`` when given, else prints via the
+    framework logger at INFO.
+    """
+    record = {
+        "namespace": "",
+        "time_ms": int(time.time() * 1000),
+        "event_type": kind,
+        "key": key,
+        "value": value,
+        "metadata": {"file": "", "lineno": 0, "mlperf_version": version},
+    }
+    line = ":::MLL " + json.dumps(record, sort_keys=True, default=str)
+    if out is not None:
+        out.append(line)
+    else:
+        logging.info("%s", line)
+    return line

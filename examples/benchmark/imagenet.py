@@ -90,12 +90,23 @@ def main(argv=None):
     step = ad.function(loss_fn, params, optax.sgd(0.1, momentum=0.9),
                        example_batch=batch)
 
+    from autodist_tpu.utils.benchmark_logger import (gather_run_info,
+                                                     get_benchmark_logger)
+    bench_logger = get_benchmark_logger()
+    bench_logger.log_run_info(gather_run_info(
+        args.model, strategy_name=args.strategy, batch_size=batch_size))
     meter = ThroughputMeter(batch_size=batch_size, log_every=args.log_every)
     loss = None
-    for _ in range(args.steps):
+    for i in range(args.steps):
         loss = step(batch)
-        meter.step(sync=loss)
+        rate = meter.step(sync=loss)
+        if rate is not None:
+            bench_logger.log_metric("examples_per_second", rate, unit="examples/s",
+                                    global_step=i + 1)
     avg = meter.average or 0.0
+    bench_logger.log_metric("average_examples_per_second", avg, unit="examples/s",
+                            global_step=args.steps)
+    bench_logger.on_finish()
     print(f"{args.model}/{args.strategy}: final loss {float(loss):.4f}, "
           f"{avg:.1f} examples/sec ({avg / max(n_dev, 1):.1f}/device)")
     return avg

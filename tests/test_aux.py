@@ -125,3 +125,52 @@ def test_throughput_meter_zero_warmup():
         time.sleep(0.001)
     assert len(meter.history) == 2
     assert meter.average > 0
+
+
+# ---------------------------------------------------------- benchmark logging
+
+def test_benchmark_file_logger_writes_json_lines(tmp_path):
+    from autodist_tpu.utils.benchmark_logger import (BENCHMARK_RUN_LOG_FILE_NAME,
+                                                     METRIC_LOG_FILE_NAME,
+                                                     BenchmarkFileLogger,
+                                                     gather_run_info)
+    import json as _json
+    logger = BenchmarkFileLogger(str(tmp_path))
+    logger.log_metric("examples_per_second", 123.4, unit="examples/s",
+                      global_step=100, extras={"model": "resnet50"})
+    logger.log_metric("bad", object())  # non-numeric: dropped, not crashed
+    logger.log_run_info(gather_run_info("resnet50", strategy_name="AllReduce",
+                                        batch_size=256))
+    logger.on_finish()
+    lines = (tmp_path / METRIC_LOG_FILE_NAME).read_text().strip().splitlines()
+    recs = [_json.loads(l) for l in lines]
+    assert recs[0]["name"] == "examples_per_second"
+    assert recs[0]["value"] == 123.4
+    assert recs[0]["extras"] == {"model": "resnet50"}
+    assert recs[-1]["name"] == "run_status"
+    run = _json.loads((tmp_path / BENCHMARK_RUN_LOG_FILE_NAME).read_text())
+    assert run["model_name"] == "resnet50"
+    assert run["machine_config"]["num_devices"] == 8
+
+
+def test_benchmark_logger_env_selection(tmp_path, monkeypatch):
+    from autodist_tpu.utils import benchmark_logger as bl
+    monkeypatch.setenv("AUTODIST_BENCHMARK_LOG_DIR", str(tmp_path))
+    assert isinstance(bl.get_benchmark_logger(), bl.BenchmarkFileLogger)
+    monkeypatch.delenv("AUTODIST_BENCHMARK_LOG_DIR")
+    logger = bl.get_benchmark_logger()
+    assert isinstance(logger, bl.BaseBenchmarkLogger)
+    logger.log_metric("x", 1.0)  # must not raise
+
+
+def test_mlperf_log_format():
+    import json as _json
+    from autodist_tpu.utils.benchmark_logger import mlperf_log
+    out = []
+    line = mlperf_log("global_batch_size", 4096, out=out)
+    assert out == [line]
+    assert line.startswith(":::MLL ")
+    rec = _json.loads(line[len(":::MLL "):])
+    assert rec["key"] == "global_batch_size"
+    assert rec["value"] == 4096
+    assert rec["event_type"] == "POINT_IN_TIME"
