@@ -80,14 +80,17 @@ class MultiHeadAttention(nn.Module):
             from autodist_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
         elif cfg.attention_impl == "ring":
-            # Requires the whole step to run inside a shard_map binding the `seq`
-            # axis with globally-offset positions — the sequence-parallel runner
-            # path. Standalone ring attention is available today via
-            # autodist_tpu.parallel.ring_attention / make_ring_attention_fn.
-            raise NotImplementedError(
-                "attention_impl='ring' is only valid inside a sequence-parallel "
-                "shard_map; use autodist_tpu.parallel.ring_attention directly, or "
-                "'flash'/'dot' for single-shard sequences")
+            # Valid only inside a shard_map binding the `seq` mesh axis with the
+            # sequence dim sharded in ring order — the sequence-parallel path
+            # (parallel/sequence.py wraps the whole step accordingly). Causality
+            # is handled globally by ring_attention, not by the local mask.
+            # Parameter init happens outside that context (no bound axis); shapes
+            # are all that matter there, so the plain path stands in.
+            if self.is_initializing():
+                ctx = dot_product_attention(q, k, v, mask, cfg.dtype)
+            else:
+                from autodist_tpu.parallel.ring_attention import ring_attention
+                ctx = ring_attention(q, k, v, causal=True)
         else:  # "dot" (config validates the value set)
             ctx = dot_product_attention(q, k, v, mask, cfg.dtype)
 
@@ -116,14 +119,18 @@ class TransformerLM(nn.Module):
     config: TransformerLMConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, pos_offset=0):
+        """``pos_offset``: global position of ``tokens[:, 0]`` — nonzero when this
+        call sees one sequence shard (the sequence-parallel path passes the ring
+        offset so position embeddings stay globally correct)."""
         cfg = self.config
         _, length = tokens.shape
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="embed")
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (cfg.max_len, cfg.d_model), jnp.float32)
-        x = emb(tokens) + pos[None, :length, :].astype(cfg.dtype)
+        pos_slice = jax.lax.dynamic_slice_in_dim(pos, pos_offset, length, axis=0)
+        x = emb(tokens) + pos_slice[None].astype(cfg.dtype)
         mask = causal_mask(length, cfg.dtype)
 
         block = Block

@@ -1,0 +1,107 @@
+"""Sequence/context parallelism: the full training path over the ``seq`` mesh axis.
+
+Long-context capability beyond the reference (SURVEY.md §5.7: the reference has no
+sequence parallelism). The sequence dimension of the batch is sharded over the
+``seq`` axis; the model runs per-shard inside one ``jax.shard_map`` with
+
+- globally-offset position embeddings (each shard passes its ring offset to the
+  model),
+- ring attention for the attention mixing (K/V rotate via ``ppermute``,
+  :mod:`autodist_tpu.parallel.ring_attention`), and
+- the loss reduced with ``psum`` over data + seq axes so the scalar is the global
+  token mean and its gradient psums back automatically through the shard_map
+  transpose.
+
+The resulting ``loss_fn(params, batch)`` has the framework's standard signature, so
+the normal :class:`~autodist_tpu.runner.DistributedRunner` drives it — sequence
+parallelism composes with data parallelism in one mesh. (Gradient compression does
+NOT compose: its sync path is itself a shard_map and cannot nest inside the SP
+loss's; the SequenceParallel builder rejects compressors at construction.)
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.parallel import plan as plan_lib
+
+_SP_AXES = plan_lib.DP_AXES + (const.MESH_AXIS_SEQ,)
+
+
+def make_sequence_parallel_loss_fn(model, mesh: Mesh) -> Callable:
+    """Build ``loss_fn(params, batch)`` computing next-token cross entropy with the
+    sequence dim sharded over the mesh's ``seq`` axis.
+
+    ``model`` must accept ``(tokens, pos_offset=...)`` and use ring attention for
+    sequence mixing (``TransformerLMConfig(attention_impl="ring")``); every other
+    layer must be positionwise, which is what makes per-shard evaluation exact.
+    ``batch = {"tokens": int32 [B, L+1]}`` with B divisible by the data axes and L
+    divisible by the seq axis.
+    """
+    seq_size = mesh.shape.get(const.MESH_AXIS_SEQ, 1)
+    tok_spec = P(plan_lib.DP_AXES, const.MESH_AXIS_SEQ)
+    max_len = getattr(getattr(model, "config", None), "max_len", None)
+
+    def local_loss(params, inputs, targets):
+        l_local = inputs.shape[1]
+        offset = jax.lax.axis_index(const.MESH_AXIS_SEQ) * l_local
+        logits = model.apply({"params": params}, inputs, pos_offset=offset)
+        logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        # Global token mean: psum local sums over every batch/sequence shard.
+        total = jax.lax.psum(nll.sum(), _SP_AXES)
+        count = jax.lax.psum(jnp.float32(nll.size), _SP_AXES)
+        return total / count
+
+    sharded = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(P(), tok_spec, tok_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        # Shift globally BEFORE sharding so targets cross shard boundaries
+        # correctly (shard s's last target is shard s+1's first input token).
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if inputs.shape[1] % seq_size:
+            raise ValueError(
+                f"Sequence length {inputs.shape[1]} is not divisible by the seq "
+                f"axis ({seq_size})")
+        if max_len is not None and inputs.shape[1] > max_len:
+            # Must be validated globally: per-shard, dynamic_slice would silently
+            # CLAMP an out-of-range pos_offset and reuse wrong position embeddings.
+            raise ValueError(
+                f"Global sequence length {inputs.shape[1]} exceeds the model's "
+                f"max_len ({max_len})")
+        return sharded(params, inputs, targets)
+
+    return loss_fn
+
+
+def create_sequence_parallel_session(autodist, model, params, optimizer):
+    """Sequence-parallel counterpart of ``AutoDist.create_distributed_session``.
+
+    The SP loss closes over the mesh (its shard_map needs it), so the mesh is
+    materialized from the compiled strategy first, then the standard runner drives
+    the sharded step. ``autodist`` should carry a strategy with a ``seq`` axis
+    (:class:`~autodist_tpu.strategy.SequenceParallel`).
+    """
+    from autodist_tpu.model_spec import ModelSpec
+    from autodist_tpu.parallel.mesh import build_mesh
+    from autodist_tpu.parallel.plan import ShardingPlan
+    from autodist_tpu.runner import DistributedRunner
+
+    model_spec = ModelSpec(params)
+    strategy = autodist.build_strategy(model_spec)
+    autodist._setup(strategy)  # multi-node: cluster + workers + jax.distributed
+    compiled = autodist._compile(model_spec)
+    plan = ShardingPlan.from_strategy(compiled, model_spec)
+    mesh = build_mesh(axes=dict(plan.mesh_axes))
+    loss_fn = make_sequence_parallel_loss_fn(model, mesh)
+    return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
+                             mesh=mesh, plan=plan)

@@ -18,10 +18,12 @@ from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import Rand
 from autodist_tpu.strategy.parallax_strategy import Parallax
 from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
 from autodist_tpu.strategy.pipeline_strategy import Pipeline
+from autodist_tpu.strategy.sequence_parallel_strategy import SequenceParallel
 
 __all__ = [
     "Strategy", "StrategyBuilder", "StrategyCompiler",
     "PS", "PSLoadBalancing", "byte_size_load_fn", "PartitionedPS",
     "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
     "RandomAxisPartitionAR", "Parallax", "ExpertParallel", "Pipeline",
+    "SequenceParallel",
 ]

@@ -48,6 +48,21 @@ def parse_ar_options(chunk_size: int, all_reduce_spec: str, compressor: str):
     return chunk_size, _SPECS[all_reduce_spec], _COMPRESSORS[compressor]
 
 
+def fill_ar_node_configs(strategy: Strategy, model_spec: ModelSpec, *, spec: int,
+                         compressor: int, chunk_size: int, power_sgd_rank: int = 2):
+    """Emit one AllReduceSynchronizer node per trainable parameter — the shared
+    emission for every replicated-parameter builder (AllReduce, SequenceParallel)."""
+    for i, pspec in enumerate(model_spec.trainable.values()):
+        node = strategy.proto.node_config.add(var_name=pspec.name)
+        node.sparse = pspec.sparse
+        ar = node.all_reduce_synchronizer
+        ar.spec = spec
+        ar.compressor = compressor
+        if compressor == strategy_pb2.AllReduceSynchronizer.POWER_SGD:
+            ar.power_sgd_rank = power_sgd_rank
+        ar.group = i // chunk_size
+
+
 class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor", power_sgd_rank: int = 2):
@@ -59,15 +74,10 @@ class AllReduce(StrategyBuilder):
 
     def build(self, model_spec: ModelSpec, resource_spec: ResourceSpec) -> Strategy:
         strategy = Strategy()
-        for i, spec in enumerate(model_spec.trainable.values()):
-            node = strategy.proto.node_config.add(var_name=spec.name)
-            node.sparse = spec.sparse
-            ar = node.all_reduce_synchronizer
-            ar.spec = self._spec
-            ar.compressor = self._compressor
-            if self._compressor == strategy_pb2.AllReduceSynchronizer.POWER_SGD:
-                ar.power_sgd_rank = self._power_sgd_rank
-            ar.group = i // self._chunk_size
+        fill_ar_node_configs(strategy, model_spec, spec=self._spec,
+                             compressor=self._compressor,
+                             chunk_size=self._chunk_size,
+                             power_sgd_rank=self._power_sgd_rank)
         self._fill_mesh_config(strategy, resource_spec,
                                self._resolved_axes(resource_spec, AR_DEFAULT_AXES))
         return strategy

@@ -1,0 +1,129 @@
+"""End-to-end sequence/context parallelism.
+
+The long-context capability (prompt/SURVEY.md §5.7: absent from the reference, a
+first-class requirement here): sequence sharded over the ``seq`` mesh axis, ring
+attention rotating K/V shards, position embeddings globally offset, loss a global
+token mean. Proven by value equivalence against the single-shard model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist
+from autodist_tpu.models import transformer_lm
+from autodist_tpu.parallel.sequence import (create_sequence_parallel_session,
+                                            make_sequence_parallel_loss_fn)
+from autodist_tpu.strategy import SequenceParallel
+
+SEQ = 32
+BATCH = 4
+
+
+def _model(attention_impl):
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=128, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_len=SEQ, dtype=jnp.float32, tied_output=False,
+        attention_impl=attention_impl)
+    return transformer_lm.init_params(cfg) + (cfg,)
+
+
+def _batch(cfg, seed=0):
+    # seq_len targets => tokens [B, SEQ+1] => inputs [B, SEQ], divisible by seq axis
+    return transformer_lm.synthetic_batch(cfg, batch_size=BATCH, seq_len=SEQ,
+                                          seed=seed)
+
+
+def test_sp_loss_and_grads_match_single_device():
+    """SP loss/grads over a (data=2, seq=4) mesh == the plain single-shard model
+    with identical parameters."""
+    model_ring, params, cfg = _model("ring")
+    model_dot, _, _ = _model("dot")
+    batch = _batch(cfg)
+
+    ref_loss_fn = transformer_lm.make_loss_fn(model_dot)
+    ref_loss, ref_grads = jax.value_and_grad(ref_loss_fn)(params, batch)
+
+    ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=4))
+    runner = create_sequence_parallel_session(ad, model_ring, params,
+                                              optax.sgd(0.1))
+    assert runner.mesh.shape["seq"] == 4
+    sp_loss_fn = make_sequence_parallel_loss_fn(model_ring, runner.mesh)
+    sp_loss, sp_grads = jax.value_and_grad(sp_loss_fn)(params, batch)
+
+    np.testing.assert_allclose(float(sp_loss), float(ref_loss), rtol=1e-5)
+    flat_ref = jax.tree_util.tree_leaves(ref_grads)
+    flat_sp = jax.tree_util.tree_leaves(sp_grads)
+    for a, b in zip(flat_ref, flat_sp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_sp_training_decreases_loss():
+    model, params, cfg = _model("ring")
+    batch = _batch(cfg)
+    ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=4))
+    runner = create_sequence_parallel_session(ad, model, params, optax.adam(1e-2))
+    state = runner.init(params)
+    losses = []
+    for _ in range(6):
+        state, loss = runner.run(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.all(np.isfinite(losses))
+
+
+def test_sp_composes_with_data_parallelism():
+    """seq=2 leaves data=4: batch shards over data, sequence over seq, same loss."""
+    model_ring, params, cfg = _model("ring")
+    model_dot, _, _ = _model("dot")
+    batch = _batch(cfg)
+    ref = float(transformer_lm.make_loss_fn(model_dot)(params, batch))
+
+    ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=2))
+    runner = create_sequence_parallel_session(ad, model_ring, params,
+                                              optax.sgd(0.1))
+    assert runner.mesh.shape["data"] == 4 and runner.mesh.shape["seq"] == 2
+    loss_fn = make_sequence_parallel_loss_fn(model_ring, runner.mesh)
+    np.testing.assert_allclose(float(loss_fn(params, batch)), ref, rtol=1e-5)
+
+
+def test_sp_rejects_indivisible_sequence():
+    model, params, cfg = _model("ring")
+    ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=4))
+    runner = create_sequence_parallel_session(ad, model, params, optax.sgd(0.1))
+    loss_fn = make_sequence_parallel_loss_fn(model, runner.mesh)
+    bad = {"tokens": np.zeros((BATCH, 31), np.int32)}  # L=30 not divisible by 4
+    with pytest.raises(ValueError, match="not divisible"):
+        loss_fn(params, bad)
+
+
+def test_sp_builder_validation():
+    with pytest.raises(ValueError):
+        SequenceParallel(seq_axis_size=0)
+    with pytest.raises(ValueError):
+        SequenceParallel(seq_axis_size=-2)
+    model, params, cfg = _model("ring")
+    from autodist_tpu.model_spec import ModelSpec
+    from autodist_tpu import ResourceSpec
+    with pytest.raises(ValueError, match="does not divide"):
+        SequenceParallel(seq_axis_size=3).build(ModelSpec(params), ResourceSpec())
+
+
+def test_sp_rejects_compressor():
+    with pytest.raises(ValueError, match="compression"):
+        SequenceParallel(seq_axis_size=2, compressor="HorovodCompressor")
+
+
+def test_sp_rejects_sequence_beyond_max_len():
+    """Out-of-range position offsets would silently clamp per-shard; the global
+    length check fails loudly instead."""
+    model, params, cfg = _model("ring")
+    ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=4))
+    runner = create_sequence_parallel_session(ad, model, params, optax.sgd(0.1))
+    loss_fn = make_sequence_parallel_loss_fn(model, runner.mesh)
+    too_long = {"tokens": np.zeros((BATCH, 2 * SEQ + 1), np.int32)}
+    with pytest.raises(ValueError, match="max_len"):
+        loss_fn(params, too_long)
