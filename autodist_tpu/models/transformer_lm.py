@@ -29,7 +29,7 @@ class TransformerLMConfig:
     dropout: float = 0.0          # deterministic by default (benchmark parity)
     dtype: Any = jnp.bfloat16     # activation/compute dtype (params stay f32)
     remat: bool = False           # jax.checkpoint each block
-    attention_impl: str = "dot"   # "dot" | "flash" | "ring" (see ops/, parallel/)
+    attention_impl: str = "dot"   # "dot" | "flash" | "ring" | "ulysses"
     # Tie input embedding and output projection. Untied matches the reference lm1b
     # model (separate sampled-softmax weights, language_model.py:15-30) and keeps the
     # embedding gather-only, so its gradient is row-sparse and Parallax routes it to
@@ -37,9 +37,9 @@ class TransformerLMConfig:
     tied_output: bool = True
 
     def __post_init__(self):
-        if self.attention_impl not in ("dot", "flash", "ring"):
+        if self.attention_impl not in ("dot", "flash", "ring", "ulysses"):
             raise ValueError(f"Unknown attention_impl {self.attention_impl!r}; "
-                             f"valid: 'dot', 'flash', 'ring'")
+                             f"valid: 'dot', 'flash', 'ring', 'ulysses'")
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
 
@@ -79,18 +79,22 @@ class MultiHeadAttention(nn.Module):
         if cfg.attention_impl == "flash":
             from autodist_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
-        elif cfg.attention_impl == "ring":
+        elif cfg.attention_impl in ("ring", "ulysses"):
             # Valid only inside a shard_map binding the `seq` mesh axis with the
             # sequence dim sharded in ring order — the sequence-parallel path
             # (parallel/sequence.py wraps the whole step accordingly). Causality
-            # is handled globally by ring_attention, not by the local mask.
-            # Parameter init happens outside that context (no bound axis); shapes
-            # are all that matter there, so the plain path stands in.
+            # is handled globally (ring masks by shard offset; ulysses regathers
+            # the full sequence), not by the local mask. Parameter init happens
+            # outside that context (no bound axis); shapes are all that matter
+            # there, so the plain path stands in.
             if self.is_initializing():
                 ctx = dot_product_attention(q, k, v, mask, cfg.dtype)
-            else:
+            elif cfg.attention_impl == "ring":
                 from autodist_tpu.parallel.ring_attention import ring_attention
                 ctx = ring_attention(q, k, v, causal=True)
+            else:
+                from autodist_tpu.parallel.ulysses import ulysses_attention
+                ctx = ulysses_attention(q, k, v, causal=True)
         else:  # "dot" (config validates the value set)
             ctx = dot_product_attention(q, k, v, mask, cfg.dtype)
 

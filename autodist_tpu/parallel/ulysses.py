@@ -1,0 +1,67 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second sequence-parallel scheme (alongside ring attention,
+:mod:`autodist_tpu.parallel.ring_attention`), after DeepSpeed-Ulysses: instead of
+rotating K/V shards around a ring, one ``all_to_all`` re-shards activations from
+sequence-sharded to head-sharded — each device then holds the FULL sequence for
+``H / seq_size`` heads, runs ordinary (flash) attention locally, and a second
+``all_to_all`` restores sequence sharding. Communication is two all-to-alls of the
+activations per attention call (vs ``seq_size - 1`` K/V rotations for ring); ring
+wins when ``seq_size`` is small or K/V are much smaller than activations, Ulysses
+wins at large ``seq_size`` since its volume is topology-constant.
+
+Requires ``n_heads % seq_size == 0``. Runs inside the same sequence-parallel
+shard_map as ring attention (``parallel/sequence.py``); local attention uses the
+pallas flash kernel, so the [L, L] score matrix never materializes either.
+"""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu import const
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      causal: bool = True,
+                      axis_name: str = const.MESH_AXIS_SEQ) -> jax.Array:
+    """Attention over seq-sharded [B, L_local, H, D] via head re-sharding.
+
+    Must run inside a ``shard_map`` binding ``axis_name``, with axis 1 the local
+    shard of the global sequence in axis-index order (same contract as
+    :func:`~autodist_tpu.parallel.ring_attention.ring_attention`).
+    """
+    seq_size = jax.lax.axis_size(axis_name)
+    if seq_size == 1:
+        from autodist_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    n_heads = q.shape[2]
+    if n_heads % seq_size:
+        raise ValueError(
+            f"Ulysses attention needs n_heads ({n_heads}) divisible by the seq "
+            f"axis ({seq_size}); use ring attention otherwise")
+
+    def to_heads(x):
+        # [B, L/s, H, D] -> [B, L, H/s, D]: split heads across the axis, gather
+        # the sequence (axis-index order == global sequence order).
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    from autodist_tpu.ops.flash_attention import flash_attention
+    out = flash_attention(qh, kh, vh, causal=causal)     # full L, H/s heads
+    # [B, L, H/s, D] -> [B, L/s, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ulysses_attention_fn(mesh: Mesh, *, causal: bool = True):
+    """Wrap :func:`ulysses_attention` in a shard_map over (data, seq) — the
+    standalone counterpart of ``make_ring_attention_fn``."""
+    spec = P((const.MESH_AXIS_DATA, const.MESH_AXIS_REDUCE),
+             const.MESH_AXIS_SEQ, None, None)
+
+    def fn(q, k, v):
+        return ulysses_attention(q, k, v, causal=causal)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
