@@ -47,7 +47,8 @@ class Cluster:
         """Deterministic host ordering -> process ids (every host derives the same
         mapping independently, reference cluster.py:70-82)."""
         nodes = self._spec.sorted_nodes
-        coordinator = f"{self._spec.chief_address}:{const.DEFAULT_COORDINATOR_PORT}"
+        port = const.ENV.AUTODIST_COORDINATOR_PORT.val
+        coordinator = f"{self._spec.chief_address}:{port}"
         return {
             "coordinator": coordinator,
             "processes": [

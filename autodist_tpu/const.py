@@ -50,6 +50,7 @@ _ENV_DEFAULTS = {
     "SYS_RESOURCE_PATH": "",
     # TPU-native additions: multi-host bootstrap (replaces tf.Server membership).
     "AUTODIST_COORDINATOR_ADDR": "",       # "ip:port" of jax.distributed coordinator
+    "AUTODIST_COORDINATOR_PORT": DEFAULT_COORDINATOR_PORT,  # chief's coordinator port
     "AUTODIST_NUM_PROCESSES": 1,
     "AUTODIST_PROCESS_ID": 0,
     # Dump jaxpr/StableHLO per build stage (reference graph visualizer parity).
@@ -69,6 +70,7 @@ class ENV(enum.Enum):
     SYS_DATA_PATH = "SYS_DATA_PATH"
     SYS_RESOURCE_PATH = "SYS_RESOURCE_PATH"
     AUTODIST_COORDINATOR_ADDR = "AUTODIST_COORDINATOR_ADDR"
+    AUTODIST_COORDINATOR_PORT = "AUTODIST_COORDINATOR_PORT"
     AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
     AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
     AUTODIST_DUMP_GRAPHS = "AUTODIST_DUMP_GRAPHS"

@@ -48,6 +48,8 @@ class Coordinator:
                 const.ENV.AUTODIST_WORKER.name: address,
                 const.ENV.AUTODIST_STRATEGY_ID.name: self._strategy.id,
                 const.ENV.AUTODIST_COORDINATOR_ADDR.name: coordinator_addr,
+                const.ENV.AUTODIST_COORDINATOR_PORT.name:
+                    str(const.ENV.AUTODIST_COORDINATOR_PORT.val),
                 const.ENV.AUTODIST_NUM_PROCESSES.name: str(n),
                 const.ENV.AUTODIST_PROCESS_ID.name: str(proc_info["process_id"]),
                 const.ENV.AUTODIST_MIN_LOG_LEVEL.name: const.ENV.AUTODIST_MIN_LOG_LEVEL.val,

@@ -45,9 +45,20 @@ def maybe_initialize_multihost(cluster=None) -> bool:
         return True
     logging.info("jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
                  coordinator, num_processes, process_id)
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            raise RuntimeError(
+                "Multi-node AutoDist must bootstrap jax.distributed before any "
+                "JAX computation, but this process already initialized the XLA "
+                "backend (e.g. via jnp array creation or jax.devices()). Keep "
+                "model setup in numpy until create_distributed_session(), or "
+                "call jax.distributed.initialize() yourself at program start."
+            ) from e
+        raise
     _initialized = True
     return True
 

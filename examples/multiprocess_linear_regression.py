@@ -1,0 +1,122 @@
+"""Multi-process linear regression: the 2-process minimum slice.
+
+Run directly as the chief (``python examples/multiprocess_linear_regression.py
+out.json``); the Coordinator re-executes this same script as the worker with the
+role env set — the reference's protocol of re-running ``python + sys.argv`` per
+host (reference ``coordinator.py:66-90``).
+Both processes join one ``jax.distributed`` coordination service (the TPU-native
+replacement for the per-node ``tf.Server`` of reference ``cluster.py:160-210``),
+build the global 4-device mesh (2 processes x 2 CPU devices), and run 3 SGD steps
+of the minimum slice through the normal ``create_distributed_session`` path. The
+chief writes final params + losses to the JSON path given in argv[1]; the pytest
+driver asserts value-exact parity with a hand-computed single-process run.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # the axon plugin overrides the env var
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist  # noqa: E402
+from autodist_tpu.strategy import AllReduce  # noqa: E402
+
+SPEC = ("nodes: [{address: localhost, tpus: 2, chief: true}, "
+        "{address: 127.0.0.1, tpus: 2}]")
+BATCH = 16
+LR = 0.1
+STEPS = 3
+
+
+def make_batch(step: int):
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(BATCH).astype(np.float32)
+    y = (3.0 * x + 2.0 + 0.1 * rng.randn(BATCH)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def loss_fn(p, b):
+    pred = b["x"] * p["w"] + p["b"]
+    return jnp.mean((b["y"] - pred) ** 2)
+
+
+def main(out_path: str):
+    ad = AutoDist(SPEC, AllReduce())
+    # numpy (not jnp) until the session exists: touching the XLA backend before
+    # jax.distributed.initialize is illegal, and create_distributed_session is
+    # what runs the multi-host bootstrap (the standard multi-host JAX constraint,
+    # surfaced through the AutoDist session protocol).
+    params = {"w": np.zeros((), np.float32), "b": np.zeros((), np.float32)}
+    runner = ad.create_distributed_session(
+        loss_fn, params, optax.sgd(LR), example_batch=make_batch(0))
+    # The session setup must have joined both processes into one SPMD program.
+    assert jax.process_count() == 2, f"process_count={jax.process_count()}"
+    assert jax.device_count() == 4, f"device_count={jax.device_count()}"
+
+    state = runner.init(params)
+    losses = []
+    for step in range(STEPS):
+        state, loss = runner.run(state, make_batch(step))
+        losses.append(float(loss))
+
+    if jax.process_index() == 0:
+        result = {
+            "w": float(state.params["w"]),
+            "b": float(state.params["b"]),
+            "losses": losses,
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+        }
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
+
+# Role env a chief subprocess must NOT inherit from its parent (a stale worker env
+# would make it think it is a worker; a stale coordinator env would misroute init).
+ROLE_ENV_VARS = ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID", "AUTODIST_PROCESS_ID",
+                 "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR",
+                 "AUTODIST_COORDINATOR_PORT")
+
+
+def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300):
+    """Launch this script as the chief subprocess on a fresh port; the Coordinator
+    inside it re-launches the worker. Shared by ``tests/test_multiprocess.py`` and
+    ``__graft_entry__._dryrun_multiprocess`` so the env construction (clean role
+    env, CPU platform, 2 local devices) stays in one place.
+    Returns the completed chief process (check ``.returncode`` and read out_path)."""
+    import socket
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # 2 local CPU devices per process -> 4 global devices across 2 processes.
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "AUTODIST_COORDINATOR_PORT": str(port),
+        "AUTODIST_WORKING_DIR": workdir,
+        # Run-by-path puts this file's dir on sys.path, not the repo root.
+        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    for k in ROLE_ENV_VARS:
+        if k != "AUTODIST_COORDINATOR_PORT":
+            env.pop(k, None)
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), str(out_path)],
+        env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/autodist_tpu/mp_lr_result.json")

@@ -1,0 +1,50 @@
+"""Real 2-process execution of the distributed runtime.
+
+The reference proved its cluster runtime by actually running it: a 2-machine CI
+stage started real tf.Servers and re-executed the user script per node
+(reference ``Jenkinsfile:91-131``, ``cluster.py:160-210``). The equivalent here
+is two OS processes on the CPU backend: the chief runs
+``tests/mp_slice_script.py``, the Coordinator re-launches the same script as the
+worker (loopback, no SSH), both call ``maybe_initialize_multihost`` and join one
+``jax.distributed`` coordination service, build a global 4-device mesh
+(2 processes x 2 devices), and step the minimum slice with real cross-process
+collectives (gloo). Value-exactness is asserted against a hand-computed
+single-process SGD run — the reference's c0 criterion
+(``tests/integration/cases/c0.py:88-121``) across a process boundary.
+"""
+
+import json
+
+import numpy as np
+
+import examples.multiprocess_linear_regression as mp_script
+
+
+def _expected_params():
+    """Hand-computed 3-step SGD on the full batch (closed form, pure numpy)."""
+    w = b = 0.0
+    losses = []
+    for step in range(mp_script.STEPS):
+        batch = mp_script.make_batch(step)
+        x, y = batch["x"], batch["y"]
+        resid = y - (w * x + b)
+        losses.append(float(np.mean(resid ** 2)))
+        w -= mp_script.LR * float(np.mean(-2.0 * x * resid))
+        b -= mp_script.LR * float(np.mean(-2.0 * resid))
+    return w, b, losses
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    out = tmp_path / "result.json"
+    proc = mp_script.run_two_process_chief(str(out), str(tmp_path / "workdir"))
+    assert proc.returncode == 0, (
+        f"chief failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    result = json.loads(out.read_text())
+
+    assert result["process_count"] == 2
+    assert result["device_count"] == 4
+    want_w, want_b, want_losses = _expected_params()
+    np.testing.assert_allclose(result["w"], want_w, rtol=1e-5)
+    np.testing.assert_allclose(result["b"], want_b, rtol=1e-5)
+    np.testing.assert_allclose(result["losses"], want_losses, rtol=1e-5)
