@@ -68,22 +68,33 @@ class Saver:
 
     # ------------------------------------------------------------------- save
     def save(self, state_or_params: PyTree, save_path: str,
-             global_step: Optional[int] = None) -> str:
+             global_step: Optional[int] = None, plan=None, runner=None) -> str:
         """Write a checkpoint. Accepts a TrainState (params + opt state + step) or a
-        bare params pytree. Returns the checkpoint prefix."""
+        bare params pytree. Returns the checkpoint prefix.
+
+        A TrainState carries its runner's plan, so padded (uneven-partition)
+        storage is automatically sliced back to original logical shapes — the
+        checkpoint stays strategy-independent (the reference's SaveSliceInfo
+        reassembly invariant). ``runner``/``plan`` override that for bare params
+        trees that came from a padded runner."""
         from autodist_tpu.runner import TrainState
 
+        if plan is None and runner is not None:
+            plan = runner.plan
+        if plan is None and isinstance(state_or_params, TrainState):
+            plan = state_or_params.plan
+        unpad = plan.unpad_params if plan is not None else (lambda t: t)
         flat: Dict[str, np.ndarray] = {}
         if isinstance(state_or_params, TrainState):
-            flat.update(_flatten_named(state_or_params.params))
+            flat.update(_flatten_named(unpad(state_or_params.params)))
             flat.update({_OPT_PREFIX + k: v for k, v in
-                         _flatten_named(state_or_params.opt_state).items()})
+                         _flatten_named(unpad(state_or_params.opt_state)).items()})
             flat.update({_EF_PREFIX + k: v for k, v in
                          _flatten_named(state_or_params.ef_state).items()
                          if not _is_per_replica_residual(k)})
             step = int(np.asarray(jax.device_get(state_or_params.step)))
         else:
-            flat.update(_flatten_named(state_or_params))
+            flat.update(_flatten_named(unpad(state_or_params)))
             step = 0
         # An explicit global_step overrides the state's counter for BOTH the file
         # name and the stored step, so they can never disagree.
@@ -170,7 +181,11 @@ class Saver:
         template_params = _fill_template_like_names(runner, params_flat)
         state = runner.init(template_params)
         if opt_flat:
-            opt_state = _fill_template(state.opt_state, opt_flat, strict=False)
+            # Checkpoints hold logical shapes; the live opt state may be padded
+            # (uneven partitioning) — fill at logical shapes, re-pad for storage.
+            opt_template = runner.plan.unpad_params(state.opt_state)
+            opt_state = runner.plan.pad_params(
+                _fill_template(opt_template, opt_flat, strict=False))
             o_sh = runner.plan.opt_sharding_tree(runner.mesh, opt_state)
             opt_state = jax.device_put(opt_state, o_sh)
         else:
@@ -184,7 +199,7 @@ class Saver:
             ef_state = state.ef_state
         from autodist_tpu.runner import TrainState
         return TrainState(step=np.asarray(step, np.int32), params=state.params,
-                          opt_state=opt_state, ef_state=ef_state)
+                          opt_state=opt_state, ef_state=ef_state, plan=runner.plan)
 
 
 def _is_per_replica_residual(name: str) -> bool:

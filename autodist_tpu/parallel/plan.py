@@ -59,6 +59,11 @@ class ParamPlan:
     # Mesh axis the partition maps onto: "model" for tensor parallelism, "expert"
     # for expert parallelism (PartitionConfig.mesh_axis).
     partition_mesh_axis: str = const.MESH_AXIS_MODEL
+    # Uneven partitioning (reference kernel/partitioner.py:660-704 sliced remainders;
+    # XLA shardings need even tiles, so storage is zero-padded to padded_dim along
+    # partition_axis and sliced back to logical_dim around the user's loss fn).
+    padded_dim: Optional[int] = None
+    logical_dim: Optional[int] = None
 
 
 class ShardingPlan:
@@ -84,6 +89,14 @@ class ShardingPlan:
                 continue
             node = nodes.get(name)
             plans[name] = cls._plan_for(node, pspec_meta, mesh_axes)
+        placement_only = [p.name for p in plans.values()
+                          if p.partition_axis is not None and p.pspec == P()]
+        if placement_only:
+            from autodist_tpu.utils import logging
+            logging.warning(
+                "Partitioning for %d parameter(s) is placement-only (the mesh has "
+                "no matching partition axis > 1, so storage stays replicated): %s",
+                len(placement_only), ", ".join(sorted(placement_only)[:8]))
         return cls(mesh_axes, plans)
 
     @staticmethod
@@ -108,14 +121,20 @@ class ShardingPlan:
 
         # Physical storage sharding: put the target mesh axis ("model" for tensor
         # parallelism, "expert" for expert parallelism) on the partitioned tensor
-        # axis when the mesh has one and the dimension tiles evenly; otherwise the
-        # parameter stays replicated and partitioning remains logical metadata.
+        # axis when the mesh has one. Dimensions that don't tile evenly get padded
+        # storage: zero-pad to the next multiple of the axis size and slice back to
+        # the logical shape around the user's computation (the TPU-native form of
+        # the reference's remainder slicing, kernel/partitioner.py:660-704).
         axis_size = mesh_axes.get(partition_mesh_axis, 1)
-        if (partition_axis is not None and axis_size > 1
-                and meta.shape[partition_axis] % axis_size == 0):
+        padded_dim = logical_dim = None
+        if partition_axis is not None and axis_size > 1:
             spec_dims: list = [None] * len(meta.shape)
             spec_dims[partition_axis] = partition_mesh_axis
             param_pspec = P(*spec_dims)
+            dim = meta.shape[partition_axis]
+            if dim % axis_size != 0:
+                logical_dim = dim
+                padded_dim = -(-dim // axis_size) * axis_size
 
         kind = node.WhichOneof("synchronizer")
         if kind is None and node.part_config:
@@ -133,7 +152,8 @@ class ShardingPlan:
                              sync=SYNC_PS, sparse=meta.sparse or node.sparse,
                              staleness=ps.staleness, synchronous=ps.sync,
                              partition_axis=partition_axis, num_shards=num_shards,
-                             partition_mesh_axis=partition_mesh_axis)
+                             partition_mesh_axis=partition_mesh_axis,
+                             padded_dim=padded_dim, logical_dim=logical_dim)
 
         ar = sync_node.all_reduce_synchronizer
         return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=param_pspec,
@@ -141,7 +161,8 @@ class ShardingPlan:
                          power_sgd_rank=max(1, ar.power_sgd_rank), group=ar.group,
                          sparse=meta.sparse or node.sparse,
                          partition_axis=partition_axis, num_shards=num_shards,
-                         partition_mesh_axis=partition_mesh_axis)
+                         partition_mesh_axis=partition_mesh_axis,
+                         padded_dim=padded_dim, logical_dim=logical_dim)
 
     # -------------------------------------------------------------- accessors
     @property
@@ -168,6 +189,51 @@ class ShardingPlan:
     @property
     def all_params_replicated(self) -> bool:
         return all(p.pspec == P() for p in self.params.values())
+
+    @property
+    def has_padding(self) -> bool:
+        """True when any parameter uses padded storage (uneven partitioning)."""
+        return any(p.padded_dim is not None for p in self.params.values())
+
+    # ------------------------------------------------- uneven (padded) storage
+    def pad_params(self, tree: Any) -> Any:
+        """Zero-pad unevenly-partitioned leaves to their physical storage shape.
+
+        Works on params AND optimizer-state trees (optax states embed copies of the
+        parameter tree, matched by name suffix). Traceable: usable inside jit.
+        """
+        return self._map_padded(tree, pad=True)
+
+    def unpad_params(self, tree: Any) -> Any:
+        """Slice padded-storage leaves back to their logical shapes (inverse of
+        :meth:`pad_params`; differentiating through this slice yields zero
+        gradients in the pad region, which is the masked update)."""
+        return self._map_padded(tree, pad=False)
+
+    def _map_padded(self, tree: Any, pad: bool) -> Any:
+        if not self.has_padding:
+            return tree
+        import jax
+        import jax.numpy as jnp
+
+        padded = {n: p for n, p in self.params.items() if p.padded_dim is not None}
+        match = _suffix_matcher(padded)
+
+        def visit(path, leaf):
+            name = match(_leaf_name(path))
+            if name is not None:
+                p = padded[name]
+                ax, want = p.partition_axis, (p.logical_dim if pad else p.padded_dim)
+                shape = getattr(leaf, "shape", ())
+                if len(shape) > ax and shape[ax] == want:
+                    if pad:
+                        widths = [(0, 0)] * len(shape)
+                        widths[ax] = (0, p.padded_dim - p.logical_dim)
+                        return jnp.pad(leaf, widths)
+                    return jax.lax.slice_in_dim(leaf, 0, p.logical_dim, axis=ax)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(visit, tree)
 
     def batch_pspec(self, ndim: int = 1) -> P:
         """Batch arrays shard their leading dim over all data-parallel axes
@@ -220,21 +286,33 @@ def _leaf_name(path) -> str:
     return _path_name(path)
 
 
+def _suffix_matcher(names):
+    """Longest-suffix param-name matching (w vs emb/w): the single definition used
+    by BOTH sharding derivation and pad/unpad, so the two can never disagree about
+    which tree leaves are parameter-derived."""
+    ordered = sorted(names, key=len, reverse=True)
+
+    def match(leaf_name: str) -> Optional[str]:
+        for name in ordered:
+            if leaf_name == name or leaf_name.endswith("/" + name):
+                return name
+        return None
+
+    return match
+
+
 def _tree_shardings_by_name(mesh: Mesh, tree: Any, pspecs_by_name: Dict[str, P]):
     """Map each leaf to a NamedSharding by longest param-name suffix match."""
     import jax
 
-    # Sort names by length so the longest suffix wins (w vs emb/w).
-    names = sorted(pspecs_by_name, key=len, reverse=True)
+    match = _suffix_matcher(pspecs_by_name)
 
     def choose(path, leaf):
-        leaf_name = _leaf_name(path)
-        for name in names:
-            if leaf_name == name or leaf_name.endswith("/" + name):
-                pspec = pspecs_by_name[name]
-                if _pspec_fits(pspec, getattr(leaf, "shape", ())):
-                    return NamedSharding(mesh, pspec)
-                break
+        name = match(_leaf_name(path))
+        if name is not None:
+            pspec = pspecs_by_name[name]
+            if _pspec_fits(pspec, getattr(leaf, "shape", ())):
+                return NamedSharding(mesh, pspec)
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(choose, tree)

@@ -233,7 +233,8 @@ class AsyncPSRunner(DistributedRunner):
         updates, opt_state = self._optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(step=state.step + 1, params=params,
-                          opt_state=opt_state, ef_state=state.ef_state)
+                          opt_state=opt_state, ef_state=state.ef_state,
+                          plan=state.plan)
 
     def _locked_apply(self, apply_fn):
         def run(state, grads):
@@ -278,7 +279,7 @@ class AsyncPSRunner(DistributedRunner):
             self._dumped = True
         from autodist_tpu.utils import tracing
         with self.mesh:
-            tracing.dump_stage("async_step", "0-original", self._loss_fn,
+            tracing.dump_stage("async_step", "0-original", self._step_loss_fn,
                                params, sharded_batch)
             tracing.dump_stage("async_step", "1-distributed", self._grad_fn,
                                params, sharded_batch, ef_state)

@@ -39,10 +39,16 @@ class TrainState:
     params: PyTree
     opt_state: PyTree
     ef_state: PyTree   # error-feedback residuals (zeros-scalars when unused)
+    # Static (untraced) reference to the ShardingPlan that shaped this state, so the
+    # Saver can slice padded uneven-partition storage back to logical shapes without
+    # the caller having to remember which runner the state came from. Compared by
+    # identity for jit caching — one runner always reuses one plan object.
+    plan: Any = None
 
 
 jax.tree_util.register_dataclass(
-    TrainState, data_fields=["step", "params", "opt_state", "ef_state"], meta_fields=[])
+    TrainState, data_fields=["step", "params", "opt_state", "ef_state"],
+    meta_fields=["plan"])
 
 
 class DistributedRunner:
@@ -63,8 +69,17 @@ class DistributedRunner:
         self.plan = plan if plan is not None \
             else ShardingPlan.from_strategy(compiled_strategy, model_spec)
         self.mesh = mesh if mesh is not None else self._mesh_from_plan()
+        # Uneven partitioning: state leaves live padded (XLA needs even tiles); the
+        # user's loss fn sees logical shapes. Differentiating through the unpad
+        # slice zero-fills the pad region of the gradient, so padded rows never
+        # receive updates (the masked-update half of pad-and-mask).
+        if self.plan.has_padding:
+            unpad = self.plan.unpad_params
+            self._step_loss_fn = lambda p, b: loss_fn(unpad(p), b)
+        else:
+            self._step_loss_fn = loss_fn
         self._grad_fn = synchronization.make_grad_fn(
-            self.plan, model_spec, self.mesh, loss_fn, has_aux=has_aux)
+            self.plan, model_spec, self.mesh, self._step_loss_fn, has_aux=has_aux)
         self._step_fn = None
         self._state_shardings = None
 
@@ -89,16 +104,19 @@ class DistributedRunner:
     # ------------------------------------------------------------------- state
     def init(self, params: PyTree, rng: Optional[jax.Array] = None) -> TrainState:
         """Place initial state onto the mesh (reference ran initializers at session
-        construction, runner.py:97-100)."""
+        construction, runner.py:97-100). Params arrive at logical shapes; unevenly
+        partitioned ones are zero-padded to their physical storage shape here."""
+        params = self.plan.pad_params(params)
         opt_state = self._optimizer.init(params)
         ef_state = synchronization.init_ef_state(self.plan, params, mesh=self.mesh)
         p_sh = self.plan.param_sharding_tree(self.mesh, params)
         o_sh = self.plan.opt_sharding_tree(self.mesh, opt_state)
         e_sh = synchronization.ef_sharding_tree(self.mesh, ef_state)
         self._state_shardings = TrainState(
-            step=NamedSharding(self.mesh, P()), params=p_sh, opt_state=o_sh, ef_state=e_sh)
+            step=NamedSharding(self.mesh, P()), params=p_sh, opt_state=o_sh,
+            ef_state=e_sh, plan=self.plan)
         state = TrainState(step=np.zeros((), np.int32), params=params,
-                           opt_state=opt_state, ef_state=ef_state)
+                           opt_state=opt_state, ef_state=ef_state, plan=self.plan)
         # Jitted identity with out_shardings: places the state on the mesh AND
         # guarantees fresh buffers (a plain device_put may alias caller-owned arrays,
         # which step donation would then delete out from under the caller).
@@ -116,7 +134,8 @@ class DistributedRunner:
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(step=state.step + 1, params=params,
-                                   opt_state=opt_state, ef_state=ef_state)
+                                   opt_state=opt_state, ef_state=ef_state,
+                                   plan=state.plan)
             return new_state, (loss, aux)
 
         donate = (0,) if self._donate else ()
@@ -149,6 +168,12 @@ class DistributedRunner:
 
         return jax.tree_util.tree_map(put, batch)
 
+    def logical_params(self, state_or_params) -> PyTree:
+        """The parameter tree at its original (user-facing, unpadded) shapes."""
+        params = state_or_params.params if isinstance(state_or_params, TrainState) \
+            else state_or_params
+        return self.plan.unpad_params(params)
+
     def run(self, state: TrainState, batch: PyTree) -> Tuple[TrainState, Any]:
         """One synchronized training step. Returns (new_state, fetches)."""
         if self._state_shardings is None:
@@ -174,7 +199,7 @@ class DistributedRunner:
             return
         from autodist_tpu.utils import tracing
         with self.mesh:
-            tracing.dump_stage("train_step", "0-original", self._loss_fn,
+            tracing.dump_stage("train_step", "0-original", self._step_loss_fn,
                                state.params, sharded_batch)
             tracing.dump_stage("train_step", "1-distributed",
                                lambda s, b: self._step_fn(s, b), state, sharded_batch)

@@ -80,42 +80,52 @@ def main(out_path: str):
 
 # Role env a chief subprocess must NOT inherit from its parent (a stale worker env
 # would make it think it is a worker; a stale coordinator env would misroute init).
+# The coordinator port is not here: run_two_process_chief always sets it fresh.
 ROLE_ENV_VARS = ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID", "AUTODIST_PROCESS_ID",
-                 "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR",
-                 "AUTODIST_COORDINATOR_PORT")
+                 "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR")
 
 
-def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300):
+def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300,
+                          attempts: int = 3):
     """Launch this script as the chief subprocess on a fresh port; the Coordinator
     inside it re-launches the worker. Shared by ``tests/test_multiprocess.py`` and
     ``__graft_entry__._dryrun_multiprocess`` so the env construction (clean role
     env, CPU platform, 2 local devices) stays in one place.
-    Returns the completed chief process (check ``.returncode`` and read out_path)."""
+    Returns the completed chief process (check ``.returncode`` and read out_path).
+
+    Port selection (bind ephemeral, close, reuse) has an inherent race: another
+    process can claim the port before the coordinator binds it, so bind failures
+    retry on a new port up to ``attempts`` times."""
     import socket
     import subprocess
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-
     env = dict(os.environ)
+    for k in ROLE_ENV_VARS:
+        env.pop(k, None)
     env.update({
         "JAX_PLATFORMS": "cpu",
         # 2 local CPU devices per process -> 4 global devices across 2 processes.
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "AUTODIST_COORDINATOR_PORT": str(port),
         "AUTODIST_WORKING_DIR": workdir,
         # Run-by-path puts this file's dir on sys.path, not the repo root.
         "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
     })
-    for k in ROLE_ENV_VARS:
-        if k != "AUTODIST_COORDINATOR_PORT":
-            env.pop(k, None)
-    return subprocess.run(
-        [sys.executable, os.path.abspath(__file__), str(out_path)],
-        env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
+
+    for attempt in range(attempts):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        env["AUTODIST_COORDINATOR_PORT"] = str(s.getsockname()[1])
+        s.close()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), str(out_path)],
+            env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
+        port_lost = proc.returncode != 0 and (
+            "address already in use" in proc.stderr.lower()
+            or "failed to bind" in proc.stderr.lower())
+        if not port_lost or attempt == attempts - 1:
+            return proc
+    return proc
 
 
 if __name__ == "__main__":

@@ -4,8 +4,9 @@ The reference proved its cluster runtime by actually running it: a 2-machine CI
 stage started real tf.Servers and re-executed the user script per node
 (reference ``Jenkinsfile:91-131``, ``cluster.py:160-210``). The equivalent here
 is two OS processes on the CPU backend: the chief runs
-``tests/mp_slice_script.py``, the Coordinator re-launches the same script as the
-worker (loopback, no SSH), both call ``maybe_initialize_multihost`` and join one
+``examples/multiprocess_linear_regression.py``, the Coordinator re-launches the
+same script as the worker (loopback, no SSH), both call
+``maybe_initialize_multihost`` and join one
 ``jax.distributed`` coordination service, build a global 4-device mesh
 (2 processes x 2 devices), and step the minimum slice with real cross-process
 collectives (gloo). Value-exactness is asserted against a hand-computed
