@@ -50,6 +50,11 @@ class ParamSpec:
     dtype: Any
     sparse: bool = False        # gradient is row-sparse (reference IndexedSlices)
     trainable: bool = True
+    # Batch-leaf name supplying the gather indices for a sparse param (jaxpr
+    # provenance analysis). Lets the synchronizer ship (indices, rows) over the
+    # wire instead of the dense scatter-add result — the reference's sparse
+    # all-gather (all_reduce_synchronizer.py:132-173) knew this from IndexedSlices.
+    index_leaf: Optional[str] = None
 
     @property
     def size(self) -> int:
@@ -108,9 +113,11 @@ class ModelSpec:
         """
         spec = cls(params)
         sparse = set(detect_sparse_params(loss_fn, params, *example_args))
+        sources = detect_sparse_index_sources(loss_fn, params, *example_args)
         for name in sparse:
             if name in spec.params:
-                spec.params[name] = dataclasses.replace(spec.params[name], sparse=True)
+                spec.params[name] = dataclasses.replace(
+                    spec.params[name], sparse=True, index_leaf=sources.get(name))
         return spec
 
     # --- accessors ---
@@ -200,6 +207,148 @@ def _sub_jaxpr(eqn):
         if type(param).__name__ == "Jaxpr":
             return param
     return None
+
+
+# Value-preserving primitives: the output holds exactly the input's index values
+# (possibly re-laid-out), so provenance flows through unchanged.
+_IDX_EXACT_PRIMS = {"broadcast_in_dim", "reshape", "convert_element_type", "squeeze",
+                    "copy", "stop_gradient", "transpose", "expand_dims"}
+
+
+def detect_sparse_index_sources(loss_fn: Callable, params: PyTree,
+                                *example_args) -> Dict[str, str]:
+    """Map sparse parameter names -> the batch-leaf name providing their gather
+    indices, by jaxpr data-flow analysis.
+
+    Walks the forward jaxpr tracking the *origin* of every intermediate: a param
+    input, an argument (batch) leaf (with any constant shifts applied to it), or
+    unknown. A mapping entry requires EVERY gather of the param to use indices
+    that are value-equal to one argument leaf — either directly (through
+    reshape/cast-style primitives) or via ``jnp.take``'s negative-index wrap
+    ``select_n(idx < 0, idx + dim0, idx)``, whose effect the synchronizer
+    reproduces at runtime. Value-transforming index arithmetic (idx+1, idx*2, a
+    second differently-indexed gather, clip-mode clamping) disqualifies the param
+    — any ambiguity drops the entry and the synchronizer falls back to the dense
+    all-reduce wire format, which is always correct.
+    """
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+    names = [_path_name(p) for p, _ in leaves_with_paths]
+    leaves = [l for _, l in leaves_with_paths]
+    arg_names: List[str] = []
+    arg_leaves: List[Any] = []
+    arg_treedefs = []
+    for pos, arg in enumerate(example_args):
+        lw, td = jax.tree_util.tree_flatten_with_path(arg)
+        arg_treedefs.append(td)
+        for path, leaf in lw:
+            # Single batch arg (the standard session signature) keeps bare names.
+            prefix = f"{pos}/" if len(example_args) > 1 else ""
+            arg_names.append(prefix + _path_name(path))
+            arg_leaves.append(leaf)
+
+    def flat_loss(*flat):
+        flat_params = flat[:len(leaves)]
+        flat_args = flat[len(leaves):]
+        tree = jax.tree_util.tree_unflatten(treedef, list(flat_params))
+        args, k = [], 0
+        for td in arg_treedefs:
+            args.append(jax.tree_util.tree_unflatten(td, list(flat_args[k:k + td.num_leaves])))
+            k += td.num_leaves
+        return loss_fn(tree, *args)
+
+    try:
+        jaxpr = jax.make_jaxpr(flat_loss)(*leaves, *arg_leaves).jaxpr
+    except Exception:
+        return {}
+
+    # Origin: ("param", name, shifts) / ("arg", name, shifts) where shifts is the
+    # frozenset of constant offsets the value may carry relative to the leaf
+    # ({0} = value-equal; {0, n} = jnp.take's negative wrap by n).
+    origin: Dict[Any, Tuple[str, str, frozenset]] = {}
+    for var, nm in zip(jaxpr.invars[:len(leaves)], names):
+        origin[var] = ("param", nm, frozenset({0}))
+    for var, nm in zip(jaxpr.invars[len(leaves):], arg_names):
+        origin[var] = ("arg", nm, frozenset({0}))
+    # Per-param: every observed gather's index origin (None = untracked indices).
+    gathers: Dict[str, set] = {}
+    _walk_index_flow(jaxpr, origin, gathers)
+    return {param: leafs.copy().pop()
+            for param, leafs in gathers.items()
+            if len(leafs) == 1 and None not in leafs}
+
+
+def _literal_int(x) -> Optional[int]:
+    if type(x).__name__ == "Literal":
+        try:
+            v = x.val
+            return int(v) if np.ndim(v) == 0 else None
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def _walk_index_flow(jaxpr, origin, gathers):
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in _TRANSPARENT_PRIMS:
+            inner = _sub_jaxpr(eqn)
+            if inner is not None:
+                inner_invars = list(getattr(inner, "invars", []))
+                offset = max(len(inner_invars) - len(eqn.invars), 0)
+                inner_origin = {}
+                for i, outer in enumerate(eqn.invars):
+                    o = origin.get(outer) if _is_var(outer) else None
+                    j = i + offset
+                    if o is not None and j < len(inner_invars):
+                        inner_origin[inner_invars[j]] = o
+                _walk_index_flow(inner, inner_origin, gathers)
+                for outer_out, inner_out in zip(eqn.outvars,
+                                                getattr(inner, "outvars", [])):
+                    o = inner_origin.get(inner_out) if _is_var(inner_out) else None
+                    if o is not None:
+                        origin[outer_out] = o
+                continue
+        if prim == "gather" and len(eqn.invars) >= 2:
+            o_param = origin.get(eqn.invars[0]) if _is_var(eqn.invars[0]) else None
+            o_idx = origin.get(eqn.invars[1]) if _is_var(eqn.invars[1]) else None
+            if o_param is not None and o_param[0] == "param" and o_param[2] == {0}:
+                leaf = None
+                if o_idx is not None and o_idx[0] == "arg":
+                    dim0 = getattr(getattr(eqn.invars[0], "aval", None), "shape",
+                                   (None,))[0]
+                    # Accept value-equal indices ({0}) or take's wrap ({0, dim0});
+                    # the synchronizer re-applies the wrap for negative indices.
+                    if o_idx[2] == {0} or (dim0 and o_idx[2] == {0, dim0}):
+                        leaf = o_idx[1]
+                gathers.setdefault(o_param[1], set()).add(leaf)
+            continue
+        if prim in _IDX_EXACT_PRIMS:
+            origins = {origin[v] for v in eqn.invars if _is_var(v) and v in origin}
+            if len(origins) == 1:
+                o = next(iter(origins))
+                for out in eqn.outvars:
+                    origin[out] = o
+        elif prim in ("add", "sub"):
+            # Constant shift of a tracked value: record the offset so the wrap
+            # pattern (idx and idx+dim0) stays recognizable; anything else is a
+            # value change and stops provenance at the gather check.
+            var_ops = [v for v in eqn.invars if _is_var(v)]
+            lits = [_literal_int(v) for v in eqn.invars if not _is_var(v)]
+            if len(var_ops) == 1 and var_ops[0] in origin and len(lits) == 1 \
+                    and lits[0] is not None:
+                kind, name, shifts = origin[var_ops[0]]
+                delta = lits[0] if prim == "add" else -lits[0]
+                origin[eqn.outvars[0]] = (kind, name,
+                                          frozenset(s + delta for s in shifts))
+        elif prim == "select_n":
+            # Branches of one tracked value (take's negative wrap): union shifts.
+            cases = [v for v in eqn.invars[1:] if _is_var(v)]
+            if cases and all(v in origin for v in cases):
+                kinds = {origin[v][:2] for v in cases}
+                if len(kinds) == 1:
+                    kind, name = next(iter(kinds))
+                    shifts = frozenset().union(*(origin[v][2] for v in cases))
+                    origin[eqn.outvars[0]] = (kind, name, shifts)
 
 
 def _collect_consumers(jaxpr, consumers):

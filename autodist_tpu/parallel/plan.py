@@ -64,6 +64,9 @@ class ParamPlan:
     # partition_axis and sliced back to logical_dim around the user's loss fn).
     padded_dim: Optional[int] = None
     logical_dim: Optional[int] = None
+    # Batch-leaf name providing this sparse param's gather indices (model_spec jaxpr
+    # provenance): enables the (indices, rows) wire format for gradient sync.
+    index_leaf: Optional[str] = None
 
 
 class ShardingPlan:
@@ -105,7 +108,8 @@ class ShardingPlan:
         if node is None:
             # No config for this param: replicate + implicit psum (safe default).
             return ParamPlan(name=meta.name, pspec=P(), opt_pspec=P(),
-                             sync=SYNC_ALLREDUCE, sparse=meta.sparse)
+                             sync=SYNC_ALLREDUCE, sparse=meta.sparse,
+                             index_leaf=meta.index_leaf)
 
         partition_axis = None
         num_shards: Tuple[int, ...] = ()
@@ -153,7 +157,8 @@ class ShardingPlan:
                              staleness=ps.staleness, synchronous=ps.sync,
                              partition_axis=partition_axis, num_shards=num_shards,
                              partition_mesh_axis=partition_mesh_axis,
-                             padded_dim=padded_dim, logical_dim=logical_dim)
+                             padded_dim=padded_dim, logical_dim=logical_dim,
+                             index_leaf=meta.index_leaf)
 
         ar = sync_node.all_reduce_synchronizer
         return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=param_pspec,
@@ -162,7 +167,8 @@ class ShardingPlan:
                          sparse=meta.sparse or node.sparse,
                          partition_axis=partition_axis, num_shards=num_shards,
                          partition_mesh_axis=partition_mesh_axis,
-                         padded_dim=padded_dim, logical_dim=logical_dim)
+                         padded_dim=padded_dim, logical_dim=logical_dim,
+                         index_leaf=meta.index_leaf)
 
     # -------------------------------------------------------------- accessors
     @property
@@ -194,6 +200,15 @@ class ShardingPlan:
     def has_padding(self) -> bool:
         """True when any parameter uses padded storage (uneven partitioning)."""
         return any(p.padded_dim is not None for p in self.params.values())
+
+    @property
+    def sparse_wire_params(self) -> Dict[str, ParamPlan]:
+        """Sparse params eligible for the (indices, rows) wire format: replicated
+        storage, known index source, no compressor (the reference likewise kept
+        sparse grads out of the compressor, all_reduce_synchronizer.py:132-173)."""
+        return {n: p for n, p in self.params.items()
+                if p.sparse and p.index_leaf and p.pspec == P()
+                and p.compressor == COMP_NONE}
 
     # ------------------------------------------------- uneven (padded) storage
     def pad_params(self, tree: Any) -> Any:

@@ -139,27 +139,44 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
     - **Implicit** (no compressor anywhere): plain ``value_and_grad`` of the global
       loss; the batch is sharded over the data axes, so XLA inserts the gradient
       all-reduce (and, with sharded opt state, the reduce-scatter) itself.
-    - **Explicit** (some parameter has a compressor): ``jax.shard_map`` over the data
-      axes — each shard computes a local gradient, compresses, ``lax.pmean``s the
-      compressed payload so the wire format is bfloat16 (or the PowerSGD factors),
-      then decompresses. Error feedback keeps a per-replica residual: x = g + ef;
-      send compress(x); ef' = x - decompress(compress(x)).
+    - **Explicit** (a compressor somewhere, or a sparse param with a known index
+      source): ``jax.shard_map`` over the data axes — each shard computes a local
+      gradient, then per parameter either compresses + ``lax.pmean``s (bfloat16 /
+      PowerSGD factors on the wire), or for sparse params all-gathers
+      (indices, touched rows) and rebuilds the dense gradient by segment-sum — the
+      reference's sparse all-gather wire path
+      (``all_reduce_synchronizer.py:132-173``): for a large embedding the wire
+      carries ~batch rows instead of the whole matrix. Error feedback keeps a
+      per-replica residual: x = g + ef; send compress(x);
+      ef' = x - decompress(compress(x)).
     """
-    if not sharding_plan.has_compression:
-        def implicit(params, batch, ef_state):
-            if has_aux:
-                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-            else:
-                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-                aux = ()
-            return grads, loss, aux, ef_state
+    dp = mesh_dp_size(mesh)
+    sparse_wire = sharding_plan.sparse_wire_params if dp > 1 else {}
+    use_explicit = sharding_plan.has_compression or bool(sparse_wire)
+
+    def implicit(params, batch, ef_state):
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            aux = ()
+        return grads, loss, aux, ef_state
+
+    if not use_explicit:
         return implicit
 
     if not sharding_plan.all_params_replicated:
-        raise NotImplementedError(
-            "Gradient compression currently requires replicated parameters "
-            "(AllReduce-family strategies); partitioned parameters with a compressor "
-            "are not supported in one strategy")
+        if sharding_plan.has_compression:
+            raise NotImplementedError(
+                "Gradient compression currently requires replicated parameters "
+                "(AllReduce-family strategies); partitioned parameters with a "
+                "compressor are not supported in one strategy")
+        # Sparse wire rides the shard_map path, which needs every parameter
+        # replicated; partitioned models keep the implicit SPMD lowering.
+        from autodist_tpu.utils import logging
+        logging.info("Sparse all-gather wire disabled: model has partitioned "
+                     "parameters; using implicit dense synchronization")
+        return implicit
 
     from autodist_tpu.model_spec import _path_name as name_of
     plans_by_name = dict(sharding_plan.params)
@@ -174,6 +191,10 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
         def sync_leaf(path, g, ef):
             param_plan = plans_by_name.get(name_of(path))
             kind = param_plan.compressor if param_plan else COMP_NONE
+            if param_plan is not None and param_plan.name in sparse_wire:
+                idx = _batch_leaf_by_name(batch, param_plan.index_leaf)
+                if idx is not None:
+                    return _SyncResult(_sparse_allgather_sync(g, idx, dp), ef)
             if kind == COMP_POWER_SGD and isinstance(ef, PowerSGDState):
                 return _powersgd_sync(g, ef)
             if kind == COMP_POWER_SGD and _powersgd_applies(g.shape):
@@ -222,6 +243,44 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
         return out
 
     return explicit
+
+
+def _batch_leaf_by_name(batch: PyTree, leaf_name: str):
+    from autodist_tpu.model_spec import _path_name
+    for path, leaf in jax.tree_util.tree_flatten_with_path(batch)[0]:
+        if _path_name(path) == leaf_name:
+            return leaf
+    return None
+
+
+def _sparse_allgather_sync(g: jax.Array, idx: jax.Array, dp: int) -> jax.Array:
+    """Sparse gradient sync: ship (indices, touched rows), not the dense matrix.
+
+    ``g`` is this replica's dense scatter-add gradient of an embedding used only
+    via gather, so it is nonzero only on rows its local indices touch. Each
+    duplicate index contributes 1/k of its row so the local scatter-sum of the
+    shipped contributions reconstructs ``g`` exactly; the all-gather then carries
+    [global_batch, dim] + [global_batch] over the wire instead of [vocab, dim]
+    (reference all_reduce_synchronizer.py:132-173 gathered IndexedSlices the same
+    way). Result equals ``pmean(g)`` bit-for-bit up to float summation order.
+    """
+    vocab = g.shape[0]
+    flat_idx = idx.reshape(-1).astype(jnp.int32)
+    # Reproduce jnp.take's negative wrap (the detected provenance allows exactly
+    # the {idx, idx+vocab} select pattern); out-of-range indices contributed no
+    # gradient (FILL_OR_DROP), so mask them out of the reconstruction too.
+    flat_idx = jnp.where(flat_idx < 0, flat_idx + vocab, flat_idx)
+    valid = (flat_idx >= 0) & (flat_idx < vocab)
+    safe_idx = jnp.where(valid, flat_idx, 0)
+    rows = jnp.take(g, safe_idx, axis=0)
+    counts = jax.ops.segment_sum(valid.astype(jnp.float32), safe_idx,
+                                 num_segments=vocab)
+    inv = jnp.where(valid, 1.0 / jnp.maximum(counts[safe_idx], 1.0), 0.0)
+    contrib = rows * inv.astype(g.dtype).reshape((-1,) + (1,) * (rows.ndim - 1))
+    all_idx = jax.lax.all_gather(safe_idx, plan_lib.DP_AXES, tiled=True)
+    all_contrib = jax.lax.all_gather(contrib, plan_lib.DP_AXES, tiled=True)
+    summed = jax.ops.segment_sum(all_contrib, all_idx, num_segments=vocab)
+    return (summed / dp).astype(g.dtype)
 
 
 def _batch_spec_maker(sharding_plan: ShardingPlan, dp: int):
