@@ -31,6 +31,22 @@ from autodist_tpu.utils import logging
 PyTree = Any
 
 
+def place_host_value(leaf, sharding) -> jax.Array:
+    """Place a host value with ``sharding``, tolerating heterogeneous processes.
+
+    ``jax.device_put`` onto a non-fully-addressable sharding runs a cross-process
+    value check built on ``process_allgather``, which requires every process to
+    have the same local device count — exactly what a heterogeneous cluster
+    (reference ``resource_specs/r4.yml``, 2+1 GPUs) violates. Building the array
+    from per-shard callbacks sidesteps the check; every process holds the same
+    full host value by construction (same batch protocol as the reference's
+    per-worker re-execution)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(leaf, sharding)
+    arr = np.asarray(leaf)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 @dataclasses.dataclass
 class TrainState:
     """One training step's carried state (a pytree)."""
@@ -80,7 +96,9 @@ class DistributedRunner:
             self._step_loss_fn = loss_fn
         self._grad_fn = synchronization.make_grad_fn(
             self.plan, model_spec, self.mesh, self._step_loss_fn, has_aux=has_aux)
-        self._step_fn = None
+        # Compiled steps keyed by fetch fn (None = plain step); reference cached
+        # one built runner per graph the same way (autodist.py:280-287).
+        self._step_fns: dict = {}
         self._state_shardings = None
 
     def _mesh_from_plan(self) -> Mesh:
@@ -125,7 +143,7 @@ class DistributedRunner:
             return place(state)
 
     # -------------------------------------------------------------------- step
-    def _build_step(self):
+    def _build_step(self, fetch_fn: Optional[Callable] = None):
         optimizer = self._optimizer
         grad_fn = self._grad_fn
 
@@ -136,15 +154,33 @@ class DistributedRunner:
             new_state = TrainState(step=state.step + 1, params=params,
                                    opt_state=opt_state, ef_state=ef_state,
                                    plan=state.plan)
-            return new_state, (loss, aux)
+            # Arbitrary fetches (reference remapper.py:125-185 fetched any graph
+            # tensor with per-kind contraction): computed in the same compiled
+            # step from the pre-update params. SPMD supplies the contractions —
+            # per-example outputs come back as the (logically concatenated)
+            # global batch-sharded array, scalars as the replicated value the
+            # reference took from the master replica.
+            fetched = fetch_fn(state.params, batch) if fetch_fn is not None else ()
+            return new_state, (loss, aux, fetched)
 
         donate = (0,) if self._donate else ()
-        self._step_fn = jax.jit(
+        jitted = jax.jit(
             step_fn,
             in_shardings=(self._state_shardings, None),
             out_shardings=(self._state_shardings, None),
             donate_argnums=donate,
         )
+        self._step_fns[fetch_fn] = jitted
+        if len(self._step_fns) > 8:
+            # Fetch callables are cache keys by identity: per-call lambdas would
+            # recompile the full step every run and pin executables forever.
+            evict = next(k for k in self._step_fns if k is not None)
+            del self._step_fns[evict]
+            logging.warning(
+                "More than 8 distinct fetch callables compiled; pass a stable "
+                "function to runner.run(fetches=...) instead of per-call lambdas "
+                "(each new identity recompiles the whole training step)")
+        return jitted
 
     def shard_batch(self, batch: PyTree) -> PyTree:
         """Feed remapping: split batch leaves across data replicas, duplicate the
@@ -164,7 +200,7 @@ class DistributedRunner:
             sharding = NamedSharding(self.mesh, spec)
             if isinstance(leaf, jax.Array) and leaf.sharding == sharding:
                 return leaf  # already resident with the right layout — no transfer
-            return jax.device_put(leaf, sharding)
+            return place_host_value(leaf, sharding)
 
         return jax.tree_util.tree_map(put, batch)
 
@@ -174,23 +210,36 @@ class DistributedRunner:
             else state_or_params
         return self.plan.unpad_params(params)
 
-    def run(self, state: TrainState, batch: PyTree) -> Tuple[TrainState, Any]:
-        """One synchronized training step. Returns (new_state, fetches)."""
+    def run(self, state: TrainState, batch: PyTree,
+            fetches: Optional[Callable] = None) -> Tuple[TrainState, Any]:
+        """One synchronized training step. Returns ``(new_state, fetched)``.
+
+        ``fetched`` defaults to the loss (or ``(loss, aux)`` with has_aux). With
+        ``fetches=fn`` — any ``fn(params, batch) -> pytree`` — it becomes
+        ``(default_fetches, fn_result)``, computed inside the same compiled step
+        from the pre-update parameters (the reference fetched arbitrary session
+        tensors the same way, remapper.py:125-185). Per-example leaves return as
+        global batch-sharded arrays (the concat contraction); scalars return
+        replicated (the master-replica contraction).
+        """
         if self._state_shardings is None:
             raise RuntimeError("Call init(params) before run()")
-        first_build = self._step_fn is None
+        step_fn = self._step_fns.get(fetches)
+        first_build = step_fn is None
         if first_build:
-            self._build_step()
+            step_fn = self._build_step(fetches)
         sharded = self.shard_batch(batch)
-        if first_build:
-            self._maybe_dump_graphs(state, sharded)
+        if first_build and not self._step_fns.keys() - {fetches}:
+            self._maybe_dump_graphs(state, sharded, step_fn)
         with self.mesh:
-            new_state, (loss, aux) = self._step_fn(state, sharded)
-        if self._has_aux:
-            return new_state, (loss, aux)
-        return new_state, loss
+            new_state, (loss, aux, fetched) = step_fn(state, sharded)
+        default = (loss, aux) if self._has_aux else loss
+        if fetches is not None:
+            return new_state, (default, fetched)
+        return new_state, default
 
-    def _maybe_dump_graphs(self, state: TrainState, sharded_batch: PyTree):
+    def _maybe_dump_graphs(self, state: TrainState, sharded_batch: PyTree,
+                           step_fn: Callable):
         """Stage snapshots (reference dumped the graph at each transform stage,
         graph_transformer.py:62-90): 0-original = the user's loss fn, 1-distributed
         = the sharded train step. ``sharded_batch`` is already on-device."""
@@ -202,7 +251,7 @@ class DistributedRunner:
             tracing.dump_stage("train_step", "0-original", self._step_loss_fn,
                                state.params, sharded_batch)
             tracing.dump_stage("train_step", "1-distributed",
-                               lambda s, b: self._step_fn(s, b), state, sharded_batch)
+                               lambda s, b: step_fn(s, b), state, sharded_batch)
 
     # Convenience parity alias: session.run(...)
     __call__ = run

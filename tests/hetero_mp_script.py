@@ -1,0 +1,71 @@
+"""Heterogeneous 2+1-device, 2-process training script (driver in
+test_multiprocess.py; not collected by pytest).
+
+The reference supported clusters with unequal per-node GPU counts and asserted
+weighted-mean gradient correctness (``resource_specs/r4.yml``,
+``tests/integration/cases/c0.py:110-120``). The SPMD equivalent: the chief
+contributes 2 CPU devices, the worker 1, the global mesh has 3 equal batch
+shards, and the per-node weighting falls out of equal per-device shards.
+"""
+
+import json
+import os
+import sys
+
+# Per-role local device count BEFORE the backend initializes: chief 2, worker 1.
+_worker = bool(os.environ.get("AUTODIST_WORKER"))
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={1 if _worker else 2}")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist  # noqa: E402
+from autodist_tpu.strategy import AllReduce  # noqa: E402
+
+SPEC = ("nodes: [{address: localhost, tpus: 2, chief: true}, "
+        "{address: 127.0.0.1, tpus: 1}]")
+BATCH = 15  # 5 examples per device over 3 devices
+LR = 0.1
+STEPS = 3
+
+
+def make_batch(step: int):
+    rng = np.random.RandomState(2000 + step)
+    x = rng.randn(BATCH).astype(np.float32)
+    y = (3.0 * x + 2.0 + 0.1 * rng.randn(BATCH)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def loss_fn(p, b):
+    pred = b["x"] * p["w"] + p["b"]
+    return jnp.mean((b["y"] - pred) ** 2)
+
+
+def main(out_path: str):
+    ad = AutoDist(SPEC, AllReduce())
+    params = {"w": np.zeros((), np.float32), "b": np.zeros((), np.float32)}
+    runner = ad.create_distributed_session(
+        loss_fn, params, optax.sgd(LR), example_batch=make_batch(0))
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 3, jax.device_count()
+
+    state = runner.init(params)
+    losses = []
+    for step in range(STEPS):
+        state, loss = runner.run(state, make_batch(step))
+        losses.append(float(loss))
+
+    if jax.process_index() == 0:
+        with open(out_path, "w") as f:
+            json.dump({"w": float(state.params["w"]), "b": float(state.params["b"]),
+                       "losses": losses, "device_count": jax.device_count()}, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

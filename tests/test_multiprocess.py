@@ -49,3 +49,33 @@ def test_two_process_training_matches_single_process(tmp_path):
     np.testing.assert_allclose(result["w"], want_w, rtol=1e-5)
     np.testing.assert_allclose(result["b"], want_b, rtol=1e-5)
     np.testing.assert_allclose(result["losses"], want_losses, rtol=1e-5)
+
+
+def test_heterogeneous_device_counts_weighted_mean(tmp_path):
+    """2 devices on the chief + 1 on the worker (the reference's r4.yml shape):
+    the 3-shard batch split must produce exactly the full-batch gradient update
+    (c0's weighted-mean assertion, tests/integration/cases/c0.py:110-120)."""
+    import os
+
+    import tests.hetero_mp_script as hetero
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "hetero_mp_script.py")
+    out = tmp_path / "result.json"
+    proc = mp_script.run_two_process_chief(
+        str(out), str(tmp_path / "workdir"), script=script)
+    assert proc.returncode == 0, (
+        f"chief failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    result = json.loads(out.read_text())
+    assert result["device_count"] == 3
+
+    w = b = 0.0
+    for step in range(hetero.STEPS):
+        batch = hetero.make_batch(step)
+        x, y = batch["x"], batch["y"]
+        resid = y - (w * x + b)
+        w -= hetero.LR * float(np.mean(-2.0 * x * resid))
+        b -= hetero.LR * float(np.mean(-2.0 * resid))
+    np.testing.assert_allclose(result["w"], w, rtol=1e-5)
+    np.testing.assert_allclose(result["b"], b, rtol=1e-5)
