@@ -65,6 +65,7 @@ class Saver:
     def __init__(self, max_to_keep: int = 5):
         self._max_to_keep = max_to_keep
         self._kept: List[str] = []
+        self._rotation_loaded = False
 
     # ------------------------------------------------------------------- save
     def save(self, state_or_params: PyTree, save_path: str,
@@ -90,8 +91,7 @@ class Saver:
             flat.update({_OPT_PREFIX + k: v for k, v in
                          _flatten_named(unpad(state_or_params.opt_state)).items()})
             flat.update({_EF_PREFIX + k: v for k, v in
-                         _flatten_named(state_or_params.ef_state).items()
-                         if not _is_per_replica_residual(k)})
+                         _flatten_ef_state(state_or_params.ef_state).items()})
             step = int(np.asarray(jax.device_get(state_or_params.step)))
         else:
             flat.update(_flatten_named(unpad(state_or_params)))
@@ -117,11 +117,29 @@ class Saver:
         with open(prefix + ".json", "w") as f:
             json.dump(manifest, f, indent=1, sort_keys=True)
 
+        self._load_rotation_state(save_path)  # adopt pre-restart checkpoints
         self._rotate(prefix)
         self._update_state_file(save_path, prefix)  # after rotation: lists live files
         logging.info("Saved checkpoint %s (step %d, %d tensors)",
                      prefix, step, len(flat))
         return prefix
+
+    def _load_rotation_state(self, save_path: str):
+        """Seed the rotation list from the directory's ``checkpoint`` state file so
+        a restarted trainer keeps rotating checkpoints written before the restart
+        (previously the list was in-memory only and pre-restart files leaked)."""
+        if self._rotation_loaded:
+            return
+        self._rotation_loaded = True
+        state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
+        try:
+            with open(state_path) as f:
+                prior = json.load(f).get("all", [])
+        except (OSError, ValueError):
+            return
+        for prefix in prior:
+            if prefix not in self._kept and os.path.exists(prefix + ".npz"):
+                self._kept.append(prefix)
 
     def _update_state_file(self, save_path: str, prefix: str):
         state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
@@ -129,6 +147,8 @@ class Saver:
             json.dump({"latest": prefix, "all": list(self._kept)}, f)
 
     def _rotate(self, prefix: str):
+        if prefix in self._kept:  # re-saving a step (e.g. checkpoint-on-resume)
+            self._kept.remove(prefix)
         self._kept.append(prefix)
         while len(self._kept) > self._max_to_keep:
             victim = self._kept.pop(0)
@@ -202,13 +222,24 @@ class Saver:
                           opt_state=opt_state, ef_state=ef_state, plan=runner.plan)
 
 
-def _is_per_replica_residual(name: str) -> bool:
-    """Per-replica [dp, ...] error-feedback residuals are transient worker-local
+def _flatten_ef_state(ef_state: PyTree) -> Dict[str, np.ndarray]:
+    """Flatten compressor state, dropping per-replica residuals by leaf identity.
+
+    Per-replica [dp, ...] error-feedback residuals are transient worker-local
     state (the reference kept them in-memory per worker, compressor.py:120-143):
     checkpointing them would cost dp x parameter size and they cannot restore onto
     a different topology anyway. Shape-stable compressor state (PowerSGD's Q) is
-    checkpointed."""
-    return name == "error" or name.endswith("/error")
+    checkpointed. Residuals are identified as the ``error`` *attribute* of the
+    EFState/PowerSGDState dataclasses (a GetAttrKey in the tree path) — a model
+    parameter that happens to be named 'error' (a DictKey) is saved normally."""
+    from autodist_tpu.model_spec import _path_name
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(ef_state)[0]:
+        last = path[-1] if path else None
+        if isinstance(last, jax.tree_util.GetAttrKey) and last.name == "error":
+            continue
+        out[_path_name(path)] = np.asarray(jax.device_get(leaf))
+    return out
 
 
 def _fill_template(template: PyTree, flat: Dict[str, np.ndarray], strict: bool = True,

@@ -15,10 +15,12 @@ host to run the *same* JAX program with a shared coordination service
   chief's own node the same way, ``cluster.py:193-196``).
 """
 
+import functools
 import json
 import os
 import shlex
 import signal
+import socket
 import subprocess
 from typing import Dict, List, Optional
 
@@ -26,13 +28,51 @@ from autodist_tpu import const
 from autodist_tpu.resource_spec import ResourceSpec, SSHConfig
 from autodist_tpu.utils import logging
 
-_LOCAL_ADDRESSES = ("localhost", "127.0.0.1", "0.0.0.0")
+_LOOPBACK_ADDRESSES = ("localhost", "127.0.0.1", "0.0.0.0", "::1")
+
+
+@functools.lru_cache(maxsize=None)
+def _own_addresses() -> frozenset:
+    """Every address this host answers to: loopback names, hostname/FQDN and their
+    resolutions, per-interface IPv4 addresses, and the primary outbound address.
+    The stdlib equivalent of the reference's netifaces enumeration
+    (utils/network.py:21-75), so a resource spec listing the chief's real IP takes
+    the local fast path instead of SSHing to itself."""
+    addrs = set(_LOOPBACK_ADDRESSES)
+    hostname = socket.gethostname()
+    addrs.add(hostname)
+    for name in (hostname, socket.getfqdn()):
+        addrs.add(name)
+        try:
+            for info in socket.getaddrinfo(name, None):
+                addrs.add(info[4][0])
+        except OSError:
+            pass
+    try:  # per-interface IPv4 addresses (Linux SIOCGIFADDR, like netifaces)
+        import fcntl
+        import struct
+        for _, ifname in socket.if_nameindex():
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                try:
+                    packed = fcntl.ioctl(s.fileno(), 0x8915,  # SIOCGIFADDR
+                                         struct.pack("256s", ifname[:15].encode()))
+                    addrs.add(socket.inet_ntoa(packed[20:24]))
+                except OSError:
+                    pass
+    except (ImportError, OSError):
+        pass
+    try:  # primary outbound interface, no packet sent
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            addrs.add(s.getsockname()[0])
+    except OSError:
+        pass
+    return frozenset(addrs)
 
 
 def is_local_address(address: str) -> bool:
-    """True for loopback/this-host addresses (reference utils/network.py:21-75 used
-    netifaces; here loopback names plus an env override list)."""
-    return address in _LOCAL_ADDRESSES
+    """True for loopback addresses and this host's own names/IPs."""
+    return address in _LOOPBACK_ADDRESSES or address in _own_addresses()
 
 
 class Cluster:

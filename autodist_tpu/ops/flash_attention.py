@@ -136,6 +136,13 @@ def _flash_forward(q, k, v, causal: bool, q_block: int, k_block: int,
         ],
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            # VMEM bound: the whole [n_q, bq] lse plane (one f32 row per query,
+            # ~4*Lq bytes) stays resident per grid row in this kernel and both
+            # backward kernels, so max single-shard sequence length is capped at
+            # roughly VMEM/4 bytes minus block working set — ~1M tokens/shard on
+            # 16MB VMEM parts, far beyond the q/k block working set that binds
+            # first in practice. Restructure to a per-q-block [bq, LANES] scratch
+            # staged out per block if shards ever approach that.
             pl.BlockSpec((1, n_q, bq), lambda bh, i, j: (bh, 0, 0)),
         ),
         out_shape=(

@@ -83,7 +83,10 @@ def build_mesh(resource_spec=None, axes: Optional[Dict[str, int]] = None,
     dims = tuple(shape.values())
 
     platform = devices[0].platform
-    if platform == "tpu":
+    # "axon" is the tunneled-TPU PJRT plugin this image runs on — same physical
+    # ICI topology concerns as the native "tpu" platform (flash-attention's
+    # backend check treats it the same way, ops/flash_attention.py).
+    if platform in ("tpu", "axon"):
         try:
             dev_array = mesh_utils.create_device_mesh(dims, devices=devices)
         except (ValueError, AssertionError):

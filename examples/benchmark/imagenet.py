@@ -97,15 +97,21 @@ def main(argv=None):
         args.model, strategy_name=args.strategy, batch_size=batch_size))
     meter = ThroughputMeter(batch_size=batch_size, log_every=args.log_every)
     loss = None
-    for i in range(args.steps):
-        loss = step(batch)
-        rate = meter.step(sync=loss)
-        if rate is not None:
-            bench_logger.log_metric("examples_per_second", rate, unit="examples/s",
-                                    global_step=i + 1)
-    avg = meter.average or 0.0
-    bench_logger.log_metric("average_examples_per_second", avg, unit="examples/s",
-                            global_step=args.steps)
+    # try/finally: a failed step must still record run_status and close the
+    # metric file handle instead of leaking it.
+    try:
+        for i in range(args.steps):
+            loss = step(batch)
+            rate = meter.step(sync=loss)
+            if rate is not None:
+                bench_logger.log_metric("examples_per_second", rate,
+                                        unit="examples/s", global_step=i + 1)
+        avg = meter.average or 0.0
+        bench_logger.log_metric("average_examples_per_second", avg,
+                                unit="examples/s", global_step=args.steps)
+    except BaseException:
+        bench_logger.on_finish(status="failure")
+        raise
     bench_logger.on_finish()
     print(f"{args.model}/{args.strategy}: final loss {float(loss):.4f}, "
           f"{avg:.1f} examples/sec ({avg / max(n_dev, 1):.1f}/device)")

@@ -184,3 +184,25 @@ def test_ef_restore_across_dp_topologies(tmp_path):
     # And training continues.
     state_b2, loss = runner_b.run(state_b, batch)
     assert np.isfinite(float(loss))
+
+
+def test_rotation_survives_restart(tmp_path):
+    """A restarted trainer (fresh Saver) must keep rotating checkpoints written
+    before the restart: rotation state persists in the 'checkpoint' state file."""
+    import glob
+
+    import numpy as np
+
+    from autodist_tpu.checkpoint import Saver
+
+    params = {"w": np.ones((2,), np.float32)}
+    s1 = Saver(max_to_keep=2)
+    for step in range(3):
+        s1.save(params, str(tmp_path / "ck"), global_step=step)
+    assert sorted(glob.glob(str(tmp_path / "ck-*.npz"))) == [
+        str(tmp_path / "ck-1.npz"), str(tmp_path / "ck-2.npz")]
+
+    s2 = Saver(max_to_keep=2)  # simulated restart
+    s2.save(params, str(tmp_path / "ck"), global_step=3)
+    assert sorted(glob.glob(str(tmp_path / "ck-*.npz"))) == [
+        str(tmp_path / "ck-2.npz"), str(tmp_path / "ck-3.npz")]

@@ -124,3 +124,18 @@ def test_is_local_address():
     assert is_local_address("localhost")
     assert is_local_address("127.0.0.1")
     assert not is_local_address("10.0.0.5")
+
+
+def test_is_local_address_own_ip():
+    """A resource spec listing the chief's real IP/hostname must take the local
+    fast path, not SSH to itself (reference utils/network.py:21-75)."""
+    import socket
+    hostname = socket.gethostname()
+    assert is_local_address(hostname)
+    try:
+        own_ip = socket.gethostbyname(hostname)
+    except OSError:
+        own_ip = None
+    if own_ip:
+        assert is_local_address(own_ip)
+    assert not is_local_address("203.0.113.7")  # TEST-NET-3: never a real host
