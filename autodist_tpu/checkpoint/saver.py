@@ -134,11 +134,13 @@ class Saver:
         state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
         try:
             with open(state_path) as f:
-                prior = json.load(f).get("all", [])
+                data = json.load(f)
         except (OSError, ValueError):
             return
+        prior = data.get("all", []) if isinstance(data, dict) else []
         for prefix in prior:
-            if prefix not in self._kept and os.path.exists(prefix + ".npz"):
+            if (isinstance(prefix, str) and prefix not in self._kept
+                    and os.path.exists(prefix + ".npz")):
                 self._kept.append(prefix)
 
     def _update_state_file(self, save_path: str, prefix: str):

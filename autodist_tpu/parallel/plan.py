@@ -50,7 +50,8 @@ class ParamPlan:
     sync: str                     # SYNC_ALLREDUCE | SYNC_PS
     compressor: int = COMP_NONE   # strategy_pb2.AllReduceSynchronizer.Compressor
     power_sgd_rank: int = 1       # approximation rank when compressor == POWER_SGD
-    group: int = 0                # collective fusion hint
+    group: int = 0                # collective fusion group (bucketing)
+    spec: int = 0                 # network tier: AUTO | ICI | DCN (hierarchical)
     sparse: bool = False
     staleness: int = 0
     synchronous: bool = True
@@ -164,6 +165,7 @@ class ShardingPlan:
         return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=param_pspec,
                          sync=SYNC_ALLREDUCE, compressor=ar.compressor,
                          power_sgd_rank=max(1, ar.power_sgd_rank), group=ar.group,
+                         spec=ar.spec,
                          sparse=meta.sparse or node.sparse,
                          partition_axis=partition_axis, num_shards=num_shards,
                          partition_mesh_axis=partition_mesh_axis,

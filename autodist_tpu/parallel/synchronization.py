@@ -111,17 +111,21 @@ class _SyncResult:
         self.state = state
 
 
-def _powersgd_sync(g: jax.Array, ef: PowerSGDState) -> _SyncResult:
+def _powersgd_sync(g: jax.Array, ef: PowerSGDState, pmean=None) -> _SyncResult:
     """One PowerSGD round inside shard_map: M = g + e; P = pmean(M Q); P_hat = QR(P);
-    Q' = pmean(M^T P_hat); synced = P_hat Q'^T; e' = M - synced (local)."""
+    Q' = pmean(M^T P_hat); synced = P_hat Q'^T; e' = M - synced (local).
+    ``pmean`` injects the spec-aware (possibly hierarchical) reduce — the factors
+    ARE the dominant transfers, so the ICI/DCN knob must apply to them."""
+    if pmean is None:
+        pmean = lambda x: jax.lax.pmean(x, plan_lib.DP_AXES)  # noqa: E731
     shape = g.shape
     m, n = shape[0], int(np.prod(shape[1:]))
     err = ef.error[0]                               # this replica's residual slice
     mat = (g + err).reshape(m, n).astype(jnp.float32)
-    p_fac = jax.lax.pmean(mat @ ef.q, plan_lib.DP_AXES)          # [m, r] on the wire
-    p_hat, _ = jnp.linalg.qr(p_fac)                              # orthonormal [m, r]
-    q_new = jax.lax.pmean(mat.T @ p_hat, plan_lib.DP_AXES)       # [n, r] on the wire
-    approx = p_hat @ q_new.T                                     # identical everywhere
+    p_fac = pmean(mat @ ef.q)                       # [m, r] on the wire
+    p_hat, _ = jnp.linalg.qr(p_fac)                 # orthonormal [m, r]
+    q_new = pmean(mat.T @ p_hat)                    # [n, r] on the wire
+    approx = p_hat @ q_new.T                        # identical everywhere
     new_err = (mat - approx).reshape(shape).astype(g.dtype)
     synced = approx.reshape(shape).astype(g.dtype)
     return _SyncResult(synced, PowerSGDState(error=new_err[None],
@@ -180,6 +184,20 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
 
     from autodist_tpu.model_spec import _path_name as name_of
     plans_by_name = dict(sharding_plan.params)
+    spec_dcn = plan_lib.strategy_pb2.AllReduceSynchronizer.DCN
+    # Two-phase reduce needs both DP axes populated (inner = intra-slice tier).
+    hierarchical_ok = all(mesh.shape.get(a, 1) > 1 for a in plan_lib.DP_AXES)
+
+    def _pmean(x, spec: int):
+        """Cross-replica mean, honoring the network-tier knob: DCN requests a
+        hierarchical two-phase reduce — inner DP axis first (lay it out on ICI
+        within a slice), then the outer axis (DCN across slices) — the TPU-native
+        reading of the reference's NCCL/RING communication hint
+        (all_reduce_synchronizer.py:102-130). AUTO/ICI is one joint reduce."""
+        if spec == spec_dcn and hierarchical_ok:
+            x = jax.lax.pmean(x, plan_lib.DP_AXES[1])  # intra-slice (ICI)
+            return jax.lax.pmean(x, plan_lib.DP_AXES[0])  # cross-slice (DCN)
+        return jax.lax.pmean(x, plan_lib.DP_AXES)
 
     def local_fn(params, batch, ef_state):
         if has_aux:
@@ -188,15 +206,62 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             aux = ()
 
+        # ---- collect leaves in traversal order so buckets can span the tree ----
+        collected = []
+
+        def _collect(path, g, ef):
+            collected.append((path, g, ef))
+            return 0
+
+        jax.tree_util.tree_map_with_path(_collect, grads, ef_state)
+
+        # ---- gradient bucketing: params sharing a fusion group id reduce as one
+        # concatenated buffer (the reference fused CollectiveReduce via
+        # ScopedAllocator with these same group ids, runner.py:41-46). Stateless
+        # and EF codecs bucket; PowerSGD (matrix-structured) and the sparse wire
+        # stay per-leaf. ----
+        buckets = {}
+        for path, g, ef in collected:
+            pp = plans_by_name.get(name_of(path))
+            kind = pp.compressor if pp else COMP_NONE
+            if pp is None or pp.name in sparse_wire or kind == COMP_POWER_SGD:
+                continue
+            if kind == COMP_BF16_EF and not isinstance(ef, EFState):
+                continue  # per-leaf path raises the diagnostic TypeError
+            if not getattr(g, "ndim", None):
+                continue
+            buckets.setdefault((pp.group, kind, pp.spec, g.dtype),
+                               []).append((path, g, ef))
+
+        bucketed_results = {}  # keyed by leaf path name
+        for (group, kind, spec, dtype), members in buckets.items():
+            if len(members) < 2:
+                continue
+            xs = [g + ef.error[0] if kind == COMP_BF16_EF else g
+                  for _, g, ef in members]
+            flat = jnp.concatenate([x.reshape(-1) for x in xs])
+            synced_flat = decompress(_pmean(compress(flat, kind), spec), dtype)
+            offset = 0
+            for (path, g, ef), x in zip(members, xs):
+                part = synced_flat[offset:offset + x.size].reshape(g.shape)
+                offset += x.size
+                if kind == COMP_BF16_EF:
+                    new_err = x - decompress(compress(x, kind), g.dtype)
+                    bucketed_results[name_of(path)] = _SyncResult(
+                        part, EFState(error=new_err[None]))
+                else:
+                    bucketed_results[name_of(path)] = _SyncResult(part, ef)
+
         def sync_leaf(path, g, ef):
             param_plan = plans_by_name.get(name_of(path))
             kind = param_plan.compressor if param_plan else COMP_NONE
+            spec = param_plan.spec if param_plan else 0
             if param_plan is not None and param_plan.name in sparse_wire:
                 idx = _batch_leaf_by_name(batch, param_plan.index_leaf)
                 if idx is not None:
                     return _SyncResult(_sparse_allgather_sync(g, idx, dp), ef)
             if kind == COMP_POWER_SGD and isinstance(ef, PowerSGDState):
-                return _powersgd_sync(g, ef)
+                return _powersgd_sync(g, ef, pmean=lambda x: _pmean(x, spec))
             if kind == COMP_POWER_SGD and _powersgd_applies(g.shape):
                 # A matrix-shaped POWER_SGD param must carry a PowerSGDState; falling
                 # through would silently all-reduce the full gradient uncompressed.
@@ -205,8 +270,7 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
                     f"(got {type(ef).__name__}); init_ef_state was bypassed")
             if kind == COMP_BF16_EF and isinstance(ef, EFState):
                 x = g + ef.error[0]
-                synced = decompress(jax.lax.pmean(compress(x, kind), plan_lib.DP_AXES),
-                                    g.dtype)
+                synced = decompress(_pmean(compress(x, kind), spec), g.dtype)
                 new_err = x - decompress(compress(x, kind), g.dtype)
                 return _SyncResult(synced, EFState(error=new_err[None]))
             if kind == COMP_BF16_EF:
@@ -215,13 +279,15 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
                     f"(got {type(ef).__name__}); init_ef_state was bypassed")
             if kind == COMP_BF16:
                 # Plain cast codec, reference HorovodCompressor semantics.
-                synced = decompress(jax.lax.pmean(compress(g, COMP_BF16),
-                                                  plan_lib.DP_AXES), g.dtype)
+                synced = decompress(_pmean(compress(g, COMP_BF16), spec), g.dtype)
                 return _SyncResult(synced, ef)
             # NONE, or POWER_SGD on a vector/scalar: exact all-reduce.
-            return _SyncResult(jax.lax.pmean(g, plan_lib.DP_AXES), ef)
+            return _SyncResult(_pmean(g, spec), ef)
 
-        results = jax.tree_util.tree_map_with_path(sync_leaf, grads, ef_state)
+        def finalize(path, g, ef):
+            return bucketed_results.get(name_of(path)) or sync_leaf(path, g, ef)
+
+        results = jax.tree_util.tree_map_with_path(finalize, grads, ef_state)
         synced = jax.tree_util.tree_map(lambda r: r.synced, results)
         new_ef = jax.tree_util.tree_map(lambda r: r.state, results)
         loss = jax.lax.pmean(loss, plan_lib.DP_AXES)
