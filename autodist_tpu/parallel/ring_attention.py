@@ -13,7 +13,10 @@ Causality is preserved globally: each ring step knows the global offset of the K
 shard it currently holds and masks accordingly.
 """
 
+import functools
+
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from autodist_tpu import const
@@ -22,61 +25,173 @@ from autodist_tpu.ops.blockwise_attention import (blockwise_attention_with_carry
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    causal: bool = True, axis_name: str = const.MESH_AXIS_SEQ,
-                   block_size: int = 256) -> jax.Array:
+                   block_size: int = 256, impl: str = "flash") -> jax.Array:
     """Attention with K/V rotating around the ``axis_name`` ring.
 
     Must run inside a ``shard_map`` (or any SPMD context) where ``axis_name`` is a
     mesh axis and the inputs' sequence dimension (axis 1 of [B, L_local, H, D]) is
     the local shard of the global sequence in ring order: device r holds global
     positions [r*L_local, (r+1)*L_local).
+
+    ``impl='flash'`` (default) runs the local step as the pallas carry kernel —
+    the same online-softmax state the kernel already carries across k-blocks is
+    the ring merge state — with a two-ring-pass custom VJP (dk/dv accumulators
+    rotate with their K/V shard). ``impl='blockwise'`` keeps the pure-JAX scan
+    (XLA-differentiated), the reference semantics for the kernel.
     """
-    ring_size = jax.lax.axis_size(axis_name)
-    my_index = jax.lax.axis_index(axis_name)
+    if impl == "flash":
+        return _ring_flash(q, k, v, causal, axis_name, block_size)
+    if impl != "blockwise":
+        raise ValueError(f"Unknown ring attention impl {impl!r}")
     _, l_local, _, _ = q.shape
 
-    q_offset = my_index * l_local
+    def attend(src, kv, carry):
+        k_cur, v_cur = kv
+        return kv, _bw_carry(q, k_cur, v_cur, carry, causal=causal,
+                             block_size=block_size,
+                             q_offset=jax.lax.axis_index(axis_name) * l_local,
+                             k_offset=src * l_local)
 
-    acc = None
-    k_cur, v_cur = k, v
-    # The shard we hold at step s originated at device (my_index - s) mod ring.
-    for step in range(ring_size):
-        src = (my_index - step) % ring_size
-        k_offset = src * l_local
-
-        def attend(operands):
-            q_, k_, v_, carry = operands
-            return _bw_carry(q_, k_, v_, carry, causal=causal,
-                             block_size=block_size, q_offset=q_offset,
-                             k_offset=k_offset)
-
-        if acc is None:
-            acc = attend((q, k_cur, v_cur, None))
-        elif causal:
-            # Shards originating strictly after ours are fully future under the
-            # causal mask — skip their FLOPs entirely (the merge is the identity).
-            acc = jax.lax.cond(src <= my_index, attend,
-                               lambda operands: operands[3],
-                               (q, k_cur, v_cur, acc))
-        else:
-            acc = attend((q, k_cur, v_cur, acc))
-        if step != ring_size - 1:
-            perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    b, lq, h, d = q.shape
+    carry0 = (jnp.zeros((b, h, lq, d), jnp.float32),
+              jnp.full((b, h, lq), -1e30, jnp.float32),
+              jnp.zeros((b, h, lq), jnp.float32))
+    _, acc = _ring_loop(axis_name, causal, (k, v), carry0, attend)
 
     out = _bw_finalize(*acc)                         # [B, H, Lq, D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+# ------------------------------------------------------------- ring scheduling
+
+def _ring_perm(ring_size):
+    return [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+
+def _ring_loop(axis_name, causal, rotating, carry, body):
+    """The ring schedule shared by forward and backward passes.
+
+    ``rotating`` (a pytree) circulates via ppermute each step; ``body(src,
+    rotating, carry) -> (rotating, carry)`` runs the local work against the shard
+    that originated on device ``src``. Under a causal mask, steps whose shard is
+    strictly future are skipped entirely (identity on both trees) — but rotation
+    still happens, keeping the ring in lockstep. The final step does not rotate
+    (the backward separately sends its traveling accumulators the last hop
+    home)."""
+    ring_size = jax.lax.axis_size(axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ring_size)
+    for step in range(ring_size):
+        src = (my_index - step) % ring_size
+
+        def run(operands):
+            return body(src, *operands)
+
+        if step == 0 or not causal:
+            # Step 0 is always our own shard (src == my_index): never skipped.
+            rotating, carry = run((rotating, carry))
+        else:
+            rotating, carry = jax.lax.cond(src <= my_index, run,
+                                           lambda operands: operands,
+                                           (rotating, carry))
+        if step != ring_size - 1:
+            rotating = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, axis_name, perm), rotating)
+    return rotating, carry
+
+
+# --------------------------------------------------------------- flash local step
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, causal, axis_name, block_size):
+    out, _ = _ring_flash_fwd(q, k, v, causal, axis_name, block_size)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, causal, axis_name, block_size):
+    from autodist_tpu.ops.flash_attention import flash_attention_with_carry
+
+    b, l_local, h, d = q.shape
+    q_offset = jax.lax.axis_index(axis_name) * l_local
+
+    def attend(src, kv, carry):
+        k_cur, v_cur = kv
+        return kv, flash_attention_with_carry(
+            q, k_cur, v_cur, carry, causal=causal, q_offset=q_offset,
+            k_offset=src * l_local, q_block=block_size, k_block=block_size)
+
+    carry0 = (jnp.zeros((b, h, l_local, d), jnp.float32),
+              jnp.full((b, h, l_local), -1e30, jnp.float32),
+              jnp.zeros((b, h, l_local), jnp.float32))
+    _, (acc, m, l) = _ring_loop(axis_name, causal, (k, v), carry0, attend)
+
+    out = _bw_finalize(acc, m, l)                       # [B, H, Lq, D] f32
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))            # [B, H, Lq]
+    out_t = out.transpose(0, 2, 1, 3).astype(q.dtype)   # [B, Lq, H, D]
+    return out_t, (q, k, v, out_t, lse)
+
+
+def _ring_flash_bwd(causal, axis_name, block_size, residuals, g):
+    """Second ring pass: each device accumulates dQ for its queries locally while
+    (dK, dV) accumulators travel WITH their K/V shard — after a full circle
+    (ring_size rotations) each shard's gradient arrives back at its home device
+    complete."""
+    import jax.experimental.pallas as pl
+
+    from autodist_tpu.ops.flash_attention import (_flash_backward_kv,
+                                                  _use_interpret,
+                                                  prepare_backward_q_side)
+
+    q, k, v, o, lse = residuals
+    b, l_local, h, d = q.shape
+    q_offset = jax.lax.axis_index(axis_name) * l_local
+
+    # Query-side layout (transposes, dO padding, D_i row term) is shard-pair
+    # independent: prepare once, reuse every ring step.
+    qf, dof, dd, bq, n_q = prepare_backward_q_side(q, o, g, block_size)
+    lse_flat = lse.reshape(b * h, l_local)
+    if n_q * bq - l_local:
+        lse_flat = jnp.pad(lse_flat, ((0, 0), (0, n_q * bq - l_local)))
+    lse_plane = lse_flat.reshape(b * h, n_q, bq)
+    interpret = _use_interpret()
+
+    def bwd_step(src, kv_and_grads, dq):
+        k_cur, v_cur, dk_acc, dv_acc = kv_and_grads
+        # out_dtype=f32: per-step contributions accumulate unquantized (a bf16
+        # round-trip per ring step would add noise proportional to ring size).
+        dqc, dkc, dvc = _flash_backward_kv(
+            qf, dof, lse_plane, dd, k_cur, v_cur, causal, bq, n_q, block_size,
+            interpret, q.shape, q_offset=q_offset, k_offset=src * l_local,
+            out_dtype=jnp.float32)
+        return (k_cur, v_cur, dk_acc + dkc, dv_acc + dvc), dq + dqc
+
+    rotating0 = (k, v, jnp.zeros(k.shape, jnp.float32),
+                 jnp.zeros(v.shape, jnp.float32))
+    (_, _, dk_acc, dv_acc), dq = _ring_loop(
+        axis_name, causal, rotating0, jnp.zeros(q.shape, jnp.float32), bwd_step)
+
+    # The accumulators are one hop short of home after ring_size-1 rotations;
+    # send just them the final hop (the K/V shards themselves are done).
+    perm = _ring_perm(jax.lax.axis_size(axis_name))
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
-                           block_size: int = 256):
+                           block_size: int = 256, impl: str = "flash"):
     """Wrap :func:`ring_attention` in a shard_map over (data, seq): batch shards on
     the data axes, sequence on ``seq``, heads/depth replicated."""
     spec = P((const.MESH_AXIS_DATA, const.MESH_AXIS_REDUCE),
              const.MESH_AXIS_SEQ, None, None)
 
     def fn(q, k, v):
-        return ring_attention(q, k, v, causal=causal, block_size=block_size)
+        return ring_attention(q, k, v, causal=causal, block_size=block_size,
+                              impl=impl)
 
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)

@@ -135,3 +135,69 @@ def test_transformer_with_flash_attention_matches_dot():
     model_flash = transformer_lm.TransformerLM(cfg_flash)
     loss_flash = transformer_lm.make_loss_fn(model_flash)(params, batch)
     np.testing.assert_allclose(float(loss_dot), float(loss_flash), rtol=1e-5)
+
+
+def test_flash_carry_matches_blockwise_carry():
+    """The pallas carry variant and the pure-JAX carry produce the same
+    (acc, m, l) state, including with offsets and a carry-in (the ring step)."""
+    from autodist_tpu.ops.blockwise_attention import blockwise_attention_with_carry
+    from autodist_tpu.ops.flash_attention import flash_attention_with_carry
+
+    rng = np.random.RandomState(0)
+    b, l, h, d = 2, 32, 2, 8
+    q = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+    k1 = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+    v1 = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+    k2 = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+    v2 = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+
+    # Two chained steps with global offsets, as the ring executes them: q shard at
+    # offset l attends its own kv (offset l) then the previous shard's (offset 0).
+    bw = blockwise_attention_with_carry(q, k1, v1, None, causal=True,
+                                        block_size=16, q_offset=l, k_offset=l)
+    bw = blockwise_attention_with_carry(q, k2, v2, bw, causal=True,
+                                        block_size=16, q_offset=l, k_offset=0)
+    fl = flash_attention_with_carry(q, k1, v1, None, causal=True,
+                                    q_offset=l, k_offset=l,
+                                    q_block=16, k_block=16)
+    fl = flash_attention_with_carry(q, k2, v2, fl, causal=True,
+                                    q_offset=l, k_offset=0,
+                                    q_block=16, k_block=16)
+    for a, b_, name in zip(fl, bw, ("acc", "m", "l")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5,
+                                   atol=1e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_ring_blockwise(causal):
+    """Forward AND gradients of the pallas-backed ring equal the pure-JAX ring."""
+    from functools import partial
+
+    mesh = build_mesh(axes={"seq": 4, "data": 2})
+    rng = np.random.RandomState(1)
+    b, l, h, d = 2, 64, 2, 8
+    q = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+
+    def run(impl):
+        spec = P(("data", "reduce"), "seq", None, None)
+        fn = jax.shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, causal=causal,
+                                              block_size=16, impl=impl),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_) ** 2)
+
+        with mesh:
+            val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return val, grads
+
+    val_bw, g_bw = run("blockwise")
+    val_fl, g_fl = run("flash")
+    np.testing.assert_allclose(float(val_fl), float(val_bw), rtol=1e-5)
+    for a, b_, name in zip(g_fl, g_bw, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4,
+                                   atol=1e-4, err_msg=f"d{name}")
