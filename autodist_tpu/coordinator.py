@@ -30,8 +30,11 @@ class Coordinator:
         self._procs = []
         self._watchdogs: List[threading.Thread] = []
 
-    def launch_clients(self):
-        """Ship strategy + relaunch the user script on every non-chief host."""
+    def launch_clients(self, extra_env: Optional[dict] = None):
+        """Ship strategy + relaunch the user script on every non-chief host.
+
+        ``extra_env``: additional env vars for the workers (e.g. the async PS
+        transport address, ``AUTODIST_PS_ADDR``)."""
         strategy_path = self._strategy.serialize()
         spec = self._cluster.cluster_spec
         coordinator_addr = spec["coordinator"]
@@ -56,6 +59,8 @@ class Coordinator:
             }
             if const.ENV.AUTODIST_IS_TESTING.val:
                 env[const.ENV.AUTODIST_IS_TESTING.name] = "1"
+            if extra_env:
+                env.update({k: str(v) for k, v in extra_env.items()})
             cmd = [sys.executable] + self._argv
             logging.info("Launching worker on %s (process %d/%d)",
                          address, proc_info["process_id"], n)

@@ -1,0 +1,217 @@
+"""Host transport for the async/bounded-stale parameter service.
+
+The reference's non-synchronous PS regimes spanned worker *processes*: each
+re-executed user script pushed gradients to PS-device accumulators over TF's
+grpc session plane and the chief-side token queues gated staleness
+(``ps_synchronizer.py:387-458``, ``:556-633``). The TPU-native async design
+keeps the regimes host-driven (``parallel/staleness.py``); this module puts the
+chief-owned :class:`ParameterService` + :class:`StalenessController` behind a
+small TCP transport so workers in OTHER processes (launched by the Coordinator)
+pull parameters and push gradients exactly like the reference's PS plane:
+
+- :class:`PSServer` — runs on the chief next to its AsyncPSRunner; each request
+  is handled on its own thread so a blocking ``start_step`` gate (the token
+  queue) does not stall other workers.
+- :class:`RemotePSWorker` — a worker process's handle: ``step(batch)`` gates on
+  the chief's staleness bound, pulls the current parameters, computes local
+  gradients on its own devices, and pushes them back.
+
+Wire format: length-prefixed pickles of numpy pytrees (the launched cluster is
+one trust domain, as with the reference's unauthenticated grpc servers). The
+SPMD data plane is untouched — this is the host-side control/parameter plane
+that has no XLA equivalent.
+"""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+PyTree = Any
+
+_HDR = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("PS transport connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _to_host(tree: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+class PSServer:
+    """Serve a chief AsyncPSRunner's service + controller to remote workers.
+
+    ``host`` defaults to loopback: the transport deserializes with pickle, so
+    binding wider than the cluster's trust domain is the caller's explicit
+    choice (pass the coordinator address for real multi-node runs — the same
+    trust model as the reference's unauthenticated tf.Servers)."""
+
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+        if runner.service is None:
+            raise RuntimeError("Call runner.init(params) before serving")
+        self._runner = runner
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                # The worker id this connection drives (from its gate messages):
+                # needed to free the gate if the worker dies mid-step.
+                self.worker_id = None
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        if msg[0] in ("start_step", "finish_step"):
+                            self.worker_id = msg[1]
+                        _send_msg(self.request, outer._dispatch(msg))
+                except (ConnectionError, OSError):
+                    # A vanished worker must not freeze the staleness gate for
+                    # everyone else (its step count would pin min(steps) forever).
+                    if self.worker_id is not None:
+                        logging.warning(
+                            "PS worker %s disconnected; retiring it from the "
+                            "staleness gate", self.worker_id)
+                        outer._runner.controller.retire(self.worker_id)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logging.info("PSServer listening on %s:%d", *self._server.server_address)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def _dispatch(self, msg):
+        op = msg[0]
+        r = self._runner
+        try:
+            if op == "start_step":
+                _, worker_id, timeout = msg
+                r.controller.start_step(worker_id, timeout)
+                return ("ok",)
+            if op == "read":
+                params, ef_state, version = r.service.read()
+                return ("ok", _to_host(params), _to_host(ef_state), version)
+            if op == "apply":
+                version = r.service.apply(msg[1])
+                return ("ok", version)
+            if op == "finish_step":
+                r.controller.finish_step(msg[1])
+                return ("ok",)
+            if op == "version":
+                return ("ok", r.service.version)
+            return ("error", "PSClientError", f"unknown op {op!r}")
+        except Exception as e:  # ship the failure to the worker, keep serving
+            return ("error", type(e).__name__, str(e))
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class PSClientError(RuntimeError):
+    """A server-side failure reported over the transport."""
+
+
+class _PSClient:
+    def __init__(self, address):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host, int(port))
+        self._sock = socket.create_connection(address)
+        self._lock = threading.Lock()
+
+    def call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            # Re-raise gate timeouts under their real type so callers written
+            # against the AsyncWorker contract (`except StalenessTimeout`) keep
+            # working across the transport.
+            kind, detail = reply[1], reply[2]
+            if kind == "StalenessTimeout":
+                from autodist_tpu.parallel.staleness import StalenessTimeout
+                raise StalenessTimeout(detail)
+            raise PSClientError(f"{kind}: {detail}")
+        return reply[1:]
+
+    def close(self):
+        self._sock.close()
+
+
+class RemotePSWorker:
+    """A worker process's handle onto the chief's parameter service.
+
+    Mirrors :class:`~autodist_tpu.parallel.staleness.AsyncWorker` but with the
+    service/controller calls crossing the transport; gradient computation runs on
+    this process's own devices through the runner's jitted grad fn.
+    """
+
+    def __init__(self, address, runner, worker_id: int):
+        self._client = _PSClient(address)
+        self._runner = runner
+        self.worker_id = worker_id
+        self.steps_completed = 0
+        self.last_version_read = -1
+
+    def warmup(self, batch: PyTree) -> None:
+        """Compile this worker's gradient program without applying an update
+        (pull params, compile, discard) — keeps process-startup compile time out
+        of the staleness-gated stepping."""
+        params, ef_state, _ = self._client.call("read")
+        sharded = self._runner.shard_batch(batch)
+        with self._runner.mesh:
+            jax.block_until_ready(self._runner.grad_fn(params, sharded, ef_state)[0])
+
+    def step(self, batch: PyTree, timeout: Optional[float] = None):
+        r = self._runner
+        self._client.call("start_step", self.worker_id, timeout)
+        params, ef_state, version = self._client.call("read")
+        self.last_version_read = version
+        sharded = r.shard_batch(batch)
+        with r.mesh:
+            grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
+        self._client.call("apply", _to_host(grads))
+        self._client.call("finish_step", self.worker_id)
+        self.steps_completed += 1
+        if r.has_aux:
+            return loss, aux
+        return loss
+
+    @property
+    def version(self) -> int:
+        return self._client.call("version")[0]
+
+    def close(self):
+        self._client.close()

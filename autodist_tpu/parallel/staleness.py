@@ -56,6 +56,7 @@ class StalenessController:
             raise ValueError("staleness must be >= 0")
         self._bound = staleness if staleness > 0 else math.inf
         self._steps = [0] * num_workers
+        self._retired = set()
         self._cond = threading.Condition()
 
     @property
@@ -64,7 +65,16 @@ class StalenessController:
             return list(self._steps)
 
     def _runnable(self, worker_id: int) -> bool:
-        return self._steps[worker_id] - min(self._steps) < self._bound
+        live = [s for i, s in enumerate(self._steps) if i not in self._retired]
+        return not live or self._steps[worker_id] - min(live) < self._bound
+
+    def retire(self, worker_id: int):
+        """Remove a dead worker from the gate (its frozen step count would
+        otherwise pin min(steps) and wedge every other worker at the bound).
+        Used by the PS transport when a remote worker disconnects."""
+        with self._cond:
+            self._retired.add(worker_id)
+            self._cond.notify_all()
 
     def start_step(self, worker_id: int, timeout: Optional[float] = None):
         """Block until the worker is within the staleness bound.

@@ -1,0 +1,137 @@
+"""Cross-process bounded-staleness PS script (driver in test_multiprocess.py).
+
+Role-split on AUTODIST_WORKER like any Coordinator-launched script. The chief
+owns the AsyncPSRunner and serves it over the PS transport; it drives worker 0
+SLOWLY (sleeping before each step). The worker process connects a
+RemotePSWorker and steps FAST, recording per-step wall times. With
+staleness=2 the fast worker must complete exactly 2 steps ahead, then block on
+the chief's gate until the slow worker advances — the reference's c9 timing
+assertion (``tests/integration/cases/c9.py:92-126``) across a real process
+boundary. No jax.distributed here: async PS processes are independent JAX
+programs joined only by the host transport, as the reference's were joined only
+by grpc.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist, const  # noqa: E402
+from autodist_tpu.strategy import PS  # noqa: E402
+
+# AutoDist sees a single-node spec (no jax.distributed bootstrap); the 2-process
+# launch runs over the Cluster/Coordinator with the transport address in env.
+SINGLE_NODE = "nodes: [{address: localhost, tpus: 1, chief: true}]"
+STALENESS = 2
+SLOW_STEPS = 4
+FAST_STEPS = 6
+SLOW_SLEEP = 0.5
+LR = 0.05
+
+
+def make_batch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16).astype(np.float32)
+    return {"x": x, "y": (3.0 * x + 2.0).astype(np.float32)}
+
+
+def loss_fn(p, b):
+    return jnp.mean((b["y"] - (b["x"] * p["w"] + p["b"])) ** 2)
+
+
+def _make_runner():
+    ad = AutoDist(SINGLE_NODE, PS(sync=True, staleness=STALENESS))
+    params = {"w": np.zeros((), np.float32), "b": np.zeros((), np.float32)}
+    runner = ad.create_distributed_session(
+        loss_fn, params, optax.sgd(LR), example_batch=make_batch(),
+        num_workers=2)
+    return runner, params, ad
+
+
+def chief_main(out_path: str):
+    from autodist_tpu.cluster import Cluster
+    from autodist_tpu.coordinator import Coordinator
+    from autodist_tpu.parallel.ps_transport import PSServer
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    runner, params, ad = _make_runner()
+    state = runner.init(params)
+    server = PSServer(runner, host="127.0.0.1")
+    host, port = server.address
+
+    cluster = Cluster(ResourceSpec(
+        "nodes: [{address: localhost, tpus: 1, chief: true}, "
+        "{address: 127.0.0.1, tpus: 1}]"))
+    coordinator = Coordinator(ad._strategy, cluster,
+                              argv=[os.path.abspath(__file__), out_path])
+    coordinator.launch_clients(extra_env={"AUTODIST_PS_ADDR": f"{host}:{port}"})
+
+    batch = make_batch()
+    slow = runner.worker(0)
+    # Compile the chief-side worker too, then wait for the remote's readiness
+    # handshake so both sides enter the timed phase together.
+    params_now, ef_now, _ = runner.service.read()
+    with runner.mesh:
+        jax.block_until_ready(
+            runner.grad_fn(params_now, runner.shard_batch(batch), ef_now)[0])
+    deadline = time.time() + 120
+    while not os.path.exists(out_path + ".ready"):
+        if time.time() > deadline:
+            raise RuntimeError("remote worker never became ready")
+        time.sleep(0.05)
+    for _ in range(SLOW_STEPS):
+        time.sleep(SLOW_SLEEP)
+        slow.step(batch, timeout=60.0)
+
+    if not coordinator.join(timeout=120.0):
+        raise RuntimeError("worker process did not finish")
+    # Total applied updates = both workers' steps.
+    result = json.loads(open(out_path + ".worker").read())
+    result["final_version"] = runner.service.version
+    result["slow_steps"] = slow.steps_completed
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    server.close()
+    cluster.terminate()
+
+
+def worker_main(out_path: str):
+    from autodist_tpu.parallel.ps_transport import RemotePSWorker
+
+    runner, _, _ad = _make_runner()  # loads the shipped strategy (AUTODIST_STRATEGY_ID)
+    remote = RemotePSWorker(os.environ["AUTODIST_PS_ADDR"], runner, worker_id=1)
+    batch = make_batch()
+    # Compile before the timed loop, then tell the chief we're ready — process
+    # startup must not eat the slow worker's head start.
+    remote.warmup(batch)
+    with open(out_path + ".ready", "w") as f:
+        f.write("1")
+    durations = []
+    versions = []
+    for _ in range(FAST_STEPS):
+        t0 = time.perf_counter()
+        remote.step(batch, timeout=60.0)
+        durations.append(time.perf_counter() - t0)
+        versions.append(remote.last_version_read)
+    with open(out_path + ".worker", "w") as f:
+        json.dump({"durations": durations, "versions_read": versions,
+                   "fast_steps": remote.steps_completed}, f)
+    remote.close()
+
+
+if __name__ == "__main__":
+    out = sys.argv[1]
+    if const.is_worker():
+        worker_main(out)
+    else:
+        chief_main(out)

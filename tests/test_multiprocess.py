@@ -79,3 +79,49 @@ def test_heterogeneous_device_counts_weighted_mean(tmp_path):
         b -= hetero.LR * float(np.mean(-2.0 * resid))
     np.testing.assert_allclose(result["w"], w, rtol=1e-5)
     np.testing.assert_allclose(result["b"], b, rtol=1e-5)
+
+
+def test_cross_process_bounded_staleness_ps(tmp_path):
+    """The c9 timing assertion across a real process boundary: a fast remote
+    worker (own process, PS transport) completes exactly `staleness` steps ahead
+    of the slow chief-side worker, then each further step blocks on the chief's
+    gate until the slow worker advances (reference c9.py:92-126)."""
+    import os
+    import subprocess
+    import sys
+
+    import tests.async_ps_script as aps
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "async_ps_script.py")
+    out = tmp_path / "async_result.json"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "AUTODIST_WORKING_DIR": str(tmp_path / "workdir"),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    for k in ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID", "AUTODIST_PROCESS_ID",
+              "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR"):
+        env.pop(k, None)
+
+    proc = subprocess.run([sys.executable, script, str(out)], env=env,
+                          cwd=os.path.dirname(os.path.dirname(script)),
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"chief failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    result = json.loads(out.read_text())
+
+    assert result["fast_steps"] == aps.FAST_STEPS
+    assert result["slow_steps"] == aps.SLOW_STEPS
+    # Every gradient from both processes was applied by the shared service.
+    assert result["final_version"] == aps.FAST_STEPS + aps.SLOW_STEPS
+
+    durations = result["durations"]
+    # First `staleness` steps run unblocked (fast); each following step must wait
+    # for the slow worker's ~SLOW_SLEEP cadence at the gate.
+    fast, gated = durations[:aps.STALENESS], durations[aps.STALENESS:]
+    assert all(d < aps.SLOW_SLEEP * 0.6 for d in fast), durations
+    assert all(d > aps.SLOW_SLEEP * 0.3 for d in gated), durations
