@@ -48,6 +48,8 @@ def main():
 
     ad = AutoDist(strategy_builder=AllReduce())
     step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)
+    # Device-resident batch: measure the chip, not the host link.
+    batch = step.runner.shard_batch(batch)
 
     # Warmup (compile + first dispatch), then timed steps. The final host read is
     # the sync barrier: the last loss depends on the whole state chain, and a

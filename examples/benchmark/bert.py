@@ -53,6 +53,9 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, AllReduce(compressor="HorovodCompressor"))
     step = ad.function(loss_fn, params, optax.adamw(1e-4), example_batch=batch)
+    # Keep the synthetic batch device-resident: re-shipping it from host
+    # every step benchmarks the host link, not the chip.
+    batch = step.runner.shard_batch(batch)
 
     meter = ThroughputMeter(batch_size=batch_size, log_every=args.log_every)
     loss = None

@@ -60,6 +60,9 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, strategy_builder=Parallax())
     step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)
+    # Keep the synthetic batch device-resident: re-shipping it from host
+    # every step benchmarks the host link, not the chip.
+    batch = step.runner.shard_batch(batch)
 
     # wps counted over target tokens, logged per --log_every steps (reference
     # lm1b_train.py:64-74 cadence).
