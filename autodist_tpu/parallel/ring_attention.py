@@ -32,7 +32,7 @@ _FLASH_MIN_LOCAL_LEN = 3072
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    causal: bool = True, axis_name: str = const.MESH_AXIS_SEQ,
-                   block_size: int = 256, impl: str = "auto") -> jax.Array:
+                   block_size: int = 512, impl: str = "auto") -> jax.Array:
     """Attention with K/V rotating around the ``axis_name`` ring.
 
     Must run inside a ``shard_map`` (or any SPMD context) where ``axis_name`` is a
@@ -49,11 +49,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     attention exists for) and blockwise below the measured crossover.
     """
     if impl == "auto":
-        if q.shape[1] >= _FLASH_MIN_LOCAL_LEN:
-            # The crossover was measured at 512 blocks; smaller blocks put the
-            # kernel in its overhead-bound regime, so auto also floors the block
-            # size (an explicit impl="flash" respects block_size as given).
-            return _ring_flash(q, k, v, causal, axis_name, max(block_size, 512))
+        # The crossover was measured at 512 blocks; a caller-tuned smaller block
+        # puts the kernel in its overhead-bound regime, so auto only picks flash
+        # when both the shard length and the block size are in its winning
+        # regime — block_size is always honored as given.
+        if q.shape[1] >= _FLASH_MIN_LOCAL_LEN and block_size >= 512:
+            return _ring_flash(q, k, v, causal, axis_name, block_size)
         impl = "blockwise"
     if impl == "flash":
         return _ring_flash(q, k, v, causal, axis_name, block_size)
@@ -199,7 +200,7 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
-                           block_size: int = 256, impl: str = "auto"):
+                           block_size: int = 512, impl: str = "auto"):
     """Wrap :func:`ring_attention` in a shard_map over (data, seq): batch shards on
     the data axes, sequence on ``seq``, heads/depth replicated."""
     spec = P((const.MESH_AXIS_DATA, const.MESH_AXIS_REDUCE),
