@@ -41,6 +41,8 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, Parallax())
     step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)
+    # Keep the synthetic batch device-resident (measure the chip, not the link).
+    batch = step.runner.shard_batch(batch)
 
     meter = ThroughputMeter(batch_size=batch_size, log_every=args.log_every)
     loss = None
