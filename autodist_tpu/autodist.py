@@ -115,10 +115,14 @@ class AutoDist:
         return self._compiled
 
     # ------------------------------------------------------------------ session
-    def _setup(self, strategy):
-        """Multi-node setup on first session creation (reference autodist.py:120-128):
-        start the cluster, chief launches worker replicas of the user script, every
-        process joins the jax.distributed coordination service."""
+    def _setup(self, strategy, async_mode: bool):
+        """Multi-node setup on first session creation (reference autodist.py:120-128).
+
+        Synchronous strategies: every process joins one jax.distributed SPMD
+        program. Non-synchronous (async / bounded-stale PS) strategies: processes
+        stay independent JAX programs joined only by the chief's parameter-service
+        transport — the reference's async workers were likewise joined only by the
+        grpc PS plane, never by collectives."""
         if self._cluster is not None or self._resource_spec.num_nodes <= 1:
             return
         from autodist_tpu.cluster import Cluster
@@ -128,8 +132,17 @@ class AutoDist:
         self._cluster.start()
         if self.is_chief:
             self._coordinator = Coordinator(strategy, self._cluster)
-            self._coordinator.launch_clients()
-        maybe_initialize_multihost(self._cluster)
+            extra_env = None
+            if async_mode:
+                # PS transport address is deterministic (coordinator port + 1) so
+                # it is known before the runner exists; shipped explicitly anyway.
+                host = self._resource_spec.chief_address
+                port = const.ENV.AUTODIST_COORDINATOR_PORT.val + 1
+                self._ps_address = f"{host}:{port}"
+                extra_env = {const.ENV.AUTODIST_PS_ADDR.name: self._ps_address}
+            self._coordinator.launch_clients(extra_env=extra_env)
+        if not async_mode:
+            maybe_initialize_multihost(self._cluster)
         import atexit
         atexit.register(self._teardown)
 
@@ -141,6 +154,9 @@ class AutoDist:
             if self._coordinator is not None:
                 self._coordinator.join(timeout=10.0)
         finally:
+            session = getattr(self, "_session", None)
+            if session is not None and hasattr(session, "close"):
+                session.close()
             if self._cluster is not None:
                 self._cluster.terminate()
 
@@ -156,22 +172,38 @@ class AutoDist:
         ``staleness>0``) return the host-driven :class:`AsyncPSRunner` instead of the
         SPMD runner — the reference switched regimes inside PSSynchronizer
         (``ps_synchronizer.py:335-458``); here the regime selects the runner.
-        ``num_workers`` sizes the async worker pool. The default is 1 (the drop-in
-        ``run()`` path drives a single worker; the staleness gate is in-process, so
-        sizing it by cluster nodes would gate against phantom workers that never
-        step) — pass it explicitly when driving multiple worker handles.
+        ``num_workers`` sizes the async worker pool. Default: one slot per
+        launched process on a multi-node cluster (slot 0 = the chief's drop-in
+        ``run()``; each worker process steps its own slot over the PS transport),
+        or a single slot on single-node runs — an in-process phantom worker that
+        never steps would deadlock the staleness gate. Pass it explicitly when
+        driving multiple in-process worker handles.
         """
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
         strategy = self.build_strategy(model_spec)
-        self._setup(strategy)
+        # Compile BEFORE multi-node setup: the plan's is_async is the single
+        # source of truth for which communication plane _setup wires (pure proto
+        # work — touches no backend, so it is safe pre-jax.distributed).
         compiled = self._compile(model_spec)
         from autodist_tpu.parallel.plan import ShardingPlan
         plan = ShardingPlan.from_strategy(compiled, model_spec)
+        self._setup(strategy, async_mode=plan.is_async)
         if plan.is_async:
             from autodist_tpu.parallel.staleness import AsyncPSRunner
-            workers = num_workers or 1
-            return AsyncPSRunner(compiled, model_spec, loss_fn, optimizer,
-                                 has_aux=has_aux, num_workers=workers, plan=plan)
+            # Multi-node async: one worker slot per launched process (each steps
+            # through the PS transport), else the documented single-slot default.
+            if num_workers:
+                workers = num_workers
+            elif self._cluster is not None:
+                workers = self._cluster.num_processes
+            else:
+                workers = 1
+            runner = AsyncPSRunner(compiled, model_spec, loss_fn, optimizer,
+                                   has_aux=has_aux, num_workers=workers, plan=plan,
+                                   ps_address=getattr(self, "_ps_address", None)
+                                   or (const.ENV.AUTODIST_PS_ADDR.val or None))
+            self._session = runner  # _teardown closes its transport endpoints
+            return runner
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
                                  has_aux=has_aux, plan=plan)
 
@@ -190,18 +222,22 @@ class AutoDist:
         internally (reference autodist.py:252-289 cached a built runner the same
         way: first call builds, later calls reuse).
 
-        Async strategies get ``num_workers=1``: the ``step`` closure is one worker's
-        loop (the reference ran one such loop per process, other workers being other
-        processes); gating it against in-process phantom workers that never step
-        would deadlock after ``staleness`` steps."""
+        Async strategies: the ``step`` closure is one worker's loop (the reference
+        ran one such loop per process); the worker pool is sized by the cluster —
+        one slot per launched process, or a single slot for single-node runs (an
+        in-process phantom worker that never steps would deadlock the gate)."""
         runner = self.create_distributed_session(
-            loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
-            num_workers=1)
+            loss_fn, params, optimizer, example_batch, sparse_names, has_aux)
         state = runner.init(params)
 
-        def step(batch):
+        def step(batch, fetches=None):
             nonlocal state
-            state, fetched = runner.run(state, batch)
+            if fetches is None:
+                state, fetched = runner.run(state, batch)
+            else:
+                # Synchronous runners only; the async regime has no in-step
+                # fetch point (its TypeError names the unsupported keyword).
+                state, fetched = runner.run(state, batch, fetches=fetches)
             return fetched
 
         step.runner = runner

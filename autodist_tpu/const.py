@@ -53,6 +53,9 @@ _ENV_DEFAULTS = {
     "AUTODIST_COORDINATOR_PORT": DEFAULT_COORDINATOR_PORT,  # chief's coordinator port
     "AUTODIST_NUM_PROCESSES": 1,
     "AUTODIST_PROCESS_ID": 0,
+    # Async-PS transport address ("host:port"); set by the chief's coordinator
+    # for worker processes when the strategy requests a non-synchronous regime.
+    "AUTODIST_PS_ADDR": "",
     # Dump jaxpr/StableHLO per build stage (reference graph visualizer parity).
     "AUTODIST_DUMP_GRAPHS": False,
 }
@@ -73,6 +76,7 @@ class ENV(enum.Enum):
     AUTODIST_COORDINATOR_PORT = "AUTODIST_COORDINATOR_PORT"
     AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
     AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
+    AUTODIST_PS_ADDR = "AUTODIST_PS_ADDR"
     AUTODIST_DUMP_GRAPHS = "AUTODIST_DUMP_GRAPHS"
 
     @property

@@ -144,11 +144,23 @@ class PSClientError(RuntimeError):
 
 
 class _PSClient:
-    def __init__(self, address):
+    def __init__(self, address, connect_timeout: float = 60.0):
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             address = (host, int(port))
-        self._sock = socket.create_connection(address)
+        # The chief serves only after its runner.init(); a worker process that
+        # starts faster retries until the server is up.
+        import time
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(address, timeout=10)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.settimeout(None)
         self._lock = threading.Lock()
 
     def call(self, *msg):

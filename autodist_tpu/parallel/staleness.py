@@ -200,7 +200,8 @@ class AsyncPSRunner(DistributedRunner):
 
     def __init__(self, compiled_strategy, model_spec, loss_fn, optimizer,
                  mesh=None, has_aux: bool = False, num_workers: int = 1,
-                 donate_state: bool = False, plan=None):
+                 donate_state: bool = False, plan=None,
+                 ps_address: Optional[str] = None):
         # Never donate: stale workers hold references to old param buffers.
         super().__init__(compiled_strategy, model_spec, loss_fn, optimizer,
                          mesh=mesh, has_aux=has_aux, donate_state=False, plan=plan)
@@ -211,6 +212,12 @@ class AsyncPSRunner(DistributedRunner):
         self.staleness = self.plan.max_staleness
         self.controller = StalenessController(self.num_workers, self.staleness)
         self.service: Optional[ParameterService] = None
+        # Cross-process wiring (multi-node async): the chief serves the service at
+        # ps_address after init(); worker-role processes route run() through a
+        # RemotePSWorker instead of the local service.
+        self._ps_address = ps_address
+        self._ps_server = None
+        self._remote_worker = None
         # The un-jitted closure re-dispatches op-by-op; async steps call it outside
         # the (jitted) sync step_fn, so compile it here.
         self._jit_grad_fn = jax.jit(self._grad_fn)
@@ -218,8 +225,14 @@ class AsyncPSRunner(DistributedRunner):
         self._dump_lock = threading.Lock()
         self._dumped = False
         self._placer = None
-        logging.info("AsyncPSRunner: %d worker(s), staleness=%s",
-                     self.num_workers, self.staleness or "unbounded")
+        logging.info("AsyncPSRunner: %d worker(s), staleness=%s%s",
+                     self.num_workers, self.staleness or "unbounded",
+                     f", transport={ps_address}" if ps_address else "")
+
+    @property
+    def _is_remote_worker(self) -> bool:
+        from autodist_tpu import const
+        return bool(self._ps_address) and const.is_worker()
 
     @property
     def grad_fn(self):
@@ -232,10 +245,18 @@ class AsyncPSRunner(DistributedRunner):
     # ------------------------------------------------------------------- state
     def init(self, params: PyTree, rng=None) -> TrainState:
         state = super().init(params, rng)
+        if self._is_remote_worker:
+            # The chief owns the authoritative state; this process only computes
+            # gradients (its local state is a template for shapes/compile).
+            return state
         apply_fn = jax.jit(
             self._apply, in_shardings=(self._state_shardings, None),
             out_shardings=self._state_shardings)
         self.service = ParameterService(state, self._locked_apply(apply_fn))
+        if self._ps_address:
+            from autodist_tpu.parallel.ps_transport import PSServer
+            host, _, port = self._ps_address.rpartition(":")
+            self._ps_server = PSServer(self, host=host, port=int(port))
         return state
 
     def _apply(self, state: TrainState, grads: PyTree) -> TrainState:
@@ -251,6 +272,16 @@ class AsyncPSRunner(DistributedRunner):
             with self.mesh:
                 return apply_fn(state, grads)
         return run
+
+    def close(self):
+        """Release transport endpoints (chief's server / worker's client). Called
+        by AutoDist teardown; safe to call repeatedly or on single-node runners."""
+        if self._ps_server is not None:
+            self._ps_server.close()
+            self._ps_server = None
+        if self._remote_worker is not None:
+            self._remote_worker.close()
+            self._remote_worker = None
 
     # ------------------------------------------------------------------ workers
     def worker(self, worker_id: int) -> AsyncWorker:
@@ -307,6 +338,19 @@ class AsyncPSRunner(DistributedRunner):
         service past the caller's snapshot — and raises."""
         if batch is None:
             state, batch = None, state
+        if self._is_remote_worker:
+            # Worker process in a multi-node async run: gradients go to the
+            # chief's service over the transport; the chief's state is
+            # authoritative, so the local state passes through untouched.
+            if self._remote_worker is None:
+                from autodist_tpu import const
+                from autodist_tpu.parallel.ps_transport import RemotePSWorker
+                self._remote_worker = RemotePSWorker(
+                    self._ps_address, self,
+                    worker_id=const.ENV.AUTODIST_PROCESS_ID.val)
+            fetched = self._remote_worker.step(batch,
+                                               timeout=self.DEFAULT_STEP_TIMEOUT)
+            return state, fetched
         if state is not None and self.service is not None:
             self.service.adopt(state, self._place)
         fetched = self.worker(worker_id).step(batch, timeout=self.DEFAULT_STEP_TIMEOUT)

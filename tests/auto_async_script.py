@@ -1,0 +1,80 @@
+"""Fully-automatic cross-process async PS (driver in test_multiprocess.py).
+
+Unlike ``async_ps_script.py`` (which wires the transport by hand to port the c9
+timing assertion), this script uses ONLY the public surface: a 2-node resource
+spec plus ``PS(staleness=...)``. ``create_distributed_session`` detects the
+non-synchronous regime, skips the jax.distributed collective program, launches
+the worker, ships the PS transport address, serves the chief's parameter
+service after init, and routes the worker's ``step`` through the transport —
+the reference's end-to-end async protocol (``ps_synchronizer.py:387-458`` over
+its grpc plane) with zero manual plumbing.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist, const  # noqa: E402
+from autodist_tpu.strategy import PS  # noqa: E402
+
+SPEC = ("nodes: [{address: localhost, tpus: 2, chief: true}, "
+        "{address: 127.0.0.1, tpus: 2}]")
+STEPS = 6
+STALENESS = 2
+LR = 0.05
+
+
+def make_batch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16).astype(np.float32)
+    return {"x": x, "y": (3.0 * x + 2.0).astype(np.float32)}
+
+
+def loss_fn(p, b):
+    return jnp.mean((b["y"] - (b["x"] * p["w"] + p["b"])) ** 2)
+
+
+def main(out_path: str):
+    ad = AutoDist(SPEC, PS(sync=True, staleness=STALENESS))
+    params = {"w": np.zeros((), np.float32), "b": np.zeros((), np.float32)}
+    batch = make_batch()
+    step = ad.function(loss_fn, params, optax.sgd(LR), example_batch=batch)
+
+    losses = [float(step(batch)) for _ in range(STEPS)]
+
+    if const.is_worker():
+        with open(out_path + ".worker", "w") as f:
+            json.dump({"worker_steps": STEPS, "losses": losses}, f)
+        return
+
+    # Chief: wait for the worker process, then record the shared service state.
+    if not ad._coordinator.join(timeout=120.0):
+        raise RuntimeError("worker process did not finish")
+    runner = step.runner
+    deadline = time.time() + 30
+    while runner.service.version < 2 * STEPS and time.time() < deadline:
+        time.sleep(0.05)
+    worker_result = json.loads(open(out_path + ".worker").read())
+    with open(out_path, "w") as f:
+        json.dump({
+            "final_version": runner.service.version,
+            "chief_steps": STEPS,
+            "worker_steps": worker_result["worker_steps"],
+            "chief_losses": losses,
+            "num_worker_slots": runner.num_workers,
+            "w": float(runner.service.state.params["w"]),
+        }, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -83,3 +83,16 @@ def test_non_divisible_batch_replicates_and_stays_exact():
     np.testing.assert_allclose(float(state.params["w"]), want_w, rtol=1e-5)
     np.testing.assert_allclose(float(state.params["b"]), want_b, rtol=1e-5)
     np.testing.assert_allclose(float(loss), float(np.mean(y ** 2)), rtol=1e-5)
+
+
+def test_function_api_supports_fetches():
+    """ad.function's step callable passes fetches through to the runner."""
+    batch = _data()
+    from autodist_tpu import AutoDist as AD
+    ad = AD(strategy_builder=AllReduce())
+    params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    step = ad.function(_loss, params, optax.sgd(LR), example_batch=batch)
+    loss0 = step(batch)
+    default, fetched = step(batch, fetches=lambda p, b: p["w"] + p["b"])
+    assert float(default) < float(loss0)
+    assert np.isfinite(float(fetched))

@@ -125,3 +125,28 @@ def test_cross_process_bounded_staleness_ps(tmp_path):
     fast, gated = durations[:aps.STALENESS], durations[aps.STALENESS:]
     assert all(d < aps.SLOW_SLEEP * 0.6 for d in fast), durations
     assert all(d > aps.SLOW_SLEEP * 0.3 for d in gated), durations
+
+
+def test_auto_wired_cross_process_async_ps(tmp_path):
+    """The public API alone (2-node spec + PS(staleness)) wires the whole async
+    protocol: worker launch, transport address shipping, chief-side serving,
+    worker-side remote stepping — no manual plumbing in the user script."""
+    import os
+
+    import tests.auto_async_script as aas
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "auto_async_script.py")
+    out = tmp_path / "auto_async.json"
+    proc = mp_script.run_two_process_chief(
+        str(out), str(tmp_path / "workdir"), script=script)
+    assert proc.returncode == 0, (
+        f"chief failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    result = json.loads(out.read_text())
+
+    assert result["num_worker_slots"] == 2
+    # Every step from BOTH processes was applied by the chief's service.
+    assert result["final_version"] == result["chief_steps"] + result["worker_steps"]
+    assert result["chief_losses"][-1] < result["chief_losses"][0]
+    assert np.isfinite(result["w"]) and result["w"] != 0.0
