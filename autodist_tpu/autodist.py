@@ -134,11 +134,16 @@ class AutoDist:
             self._coordinator = Coordinator(strategy, self._cluster)
             extra_env = None
             if async_mode:
-                # PS transport address is deterministic (coordinator port + 1) so
-                # it is known before the runner exists; shipped explicitly anyway.
+                # Reserve the PS transport port NOW (the server itself starts
+                # after runner.init): binding before shipping the address means
+                # workers never connect to a guessed, possibly-taken port.
+                import socket as _socket
                 host = self._resource_spec.chief_address
-                port = const.ENV.AUTODIST_COORDINATOR_PORT.val + 1
-                self._ps_address = f"{host}:{port}"
+                sock = _socket.socket()
+                sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+                sock.bind((host, 0))
+                self._ps_listen_sock = sock
+                self._ps_address = f"{host}:{sock.getsockname()[1]}"
                 extra_env = {const.ENV.AUTODIST_PS_ADDR.name: self._ps_address}
             self._coordinator.launch_clients(extra_env=extra_env)
         if not async_mode:
@@ -202,6 +207,7 @@ class AutoDist:
                                    has_aux=has_aux, num_workers=workers, plan=plan,
                                    ps_address=getattr(self, "_ps_address", None)
                                    or (const.ENV.AUTODIST_PS_ADDR.val or None))
+            runner._ps_listen_sock = getattr(self, "_ps_listen_sock", None)
             self._session = runner  # _teardown closes its transport endpoints
             return runner
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,

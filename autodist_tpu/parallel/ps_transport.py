@@ -71,7 +71,11 @@ class PSServer:
     choice (pass the coordinator address for real multi-node runs — the same
     trust model as the reference's unauthenticated tf.Servers)."""
 
-    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
+                 listen_sock: Optional[socket.socket] = None):
+        """``listen_sock``: an already-bound listening socket to adopt — the
+        launcher binds it BEFORE shipping the address to workers, so the port is
+        reserved rather than guessed (no bind race at init time)."""
         if runner.service is None:
             raise RuntimeError("Call runner.init(params) before serving")
         self._runner = runner
@@ -101,7 +105,14 @@ class PSServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server((host, port), Handler)
+        if listen_sock is not None:
+            self._server = Server(listen_sock.getsockname(), Handler,
+                                  bind_and_activate=False)
+            self._server.socket.close()
+            self._server.socket = listen_sock
+            self._server.server_activate()
+        else:
+            self._server = Server((host, port), Handler)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -98,7 +98,8 @@ def create_sequence_parallel_session(autodist, model, params, optimizer):
 
     model_spec = ModelSpec(params)
     strategy = autodist.build_strategy(model_spec)
-    autodist._setup(strategy)  # multi-node: cluster + workers + jax.distributed
+    # Multi-node: cluster + workers + jax.distributed (SP is always synchronous).
+    autodist._setup(strategy, async_mode=False)
     compiled = autodist._compile(model_spec)
     plan = ShardingPlan.from_strategy(compiled, model_spec)
     mesh = build_mesh(axes=dict(plan.mesh_axes))

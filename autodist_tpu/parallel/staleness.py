@@ -216,8 +216,10 @@ class AsyncPSRunner(DistributedRunner):
         # ps_address after init(); worker-role processes route run() through a
         # RemotePSWorker instead of the local service.
         self._ps_address = ps_address
+        self._ps_listen_sock = None   # pre-bound socket from AutoDist._setup
         self._ps_server = None
         self._remote_worker = None
+        self._last_returned = None
         # The un-jitted closure re-dispatches op-by-op; async steps call it outside
         # the (jitted) sync step_fn, so compile it here.
         self._jit_grad_fn = jax.jit(self._grad_fn)
@@ -256,7 +258,8 @@ class AsyncPSRunner(DistributedRunner):
         if self._ps_address:
             from autodist_tpu.parallel.ps_transport import PSServer
             host, _, port = self._ps_address.rpartition(":")
-            self._ps_server = PSServer(self, host=host, port=int(port))
+            self._ps_server = PSServer(self, host=host, port=int(port),
+                                       listen_sock=self._ps_listen_sock)
         return state
 
     def _apply(self, state: TrainState, grads: PyTree) -> TrainState:
@@ -351,9 +354,17 @@ class AsyncPSRunner(DistributedRunner):
             fetched = self._remote_worker.step(batch,
                                                timeout=self.DEFAULT_STEP_TIMEOUT)
             return state, fetched
-        if state is not None and self.service is not None:
+        # Only a genuinely foreign state (checkpoint restore) is adopted. A state
+        # this runner previously returned is just the drop-in loop handing back
+        # its last snapshot — other workers may have advanced the service since
+        # (their applies land between our return and the next call), and adopting
+        # would falsely report a conflict.
+        if (state is not None and self.service is not None
+                and state is not self._last_returned):
             self.service.adopt(state, self._place)
         fetched = self.worker(worker_id).step(batch, timeout=self.DEFAULT_STEP_TIMEOUT)
-        return self.service.state, fetched
+        current = self.service.state
+        self._last_returned = current
+        return current, fetched
 
     __call__ = run

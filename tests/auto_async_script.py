@@ -50,7 +50,14 @@ def main(out_path: str):
     batch = make_batch()
     step = ad.function(loss_fn, params, optax.sgd(LR), example_batch=batch)
 
-    losses = [float(step(batch)) for _ in range(STEPS)]
+    losses = []
+    for _ in range(STEPS):
+        losses.append(float(step(batch)))
+        if not const.is_worker():
+            # Host-side gap between chief steps: remote applies land here, which
+            # must NOT trip the foreign-state check on the next step (the chief
+            # hands back its last returned snapshot, not a checkpoint).
+            time.sleep(0.05)
 
     if const.is_worker():
         with open(out_path + ".worker", "w") as f:
