@@ -1,9 +1,20 @@
 """Shared loss helpers for the model zoo."""
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def jit_init(model, *args, rng: Optional[jax.Array] = None):
+    """``model.init`` under jit, returning the params tree.
+
+    One compiled program instead of eager op-by-op dispatch: on a tunneled chip
+    every eager op costs a host round trip, which made deep-CNN initialization
+    (DenseNet-121) take minutes; jitted it takes seconds. The single place all
+    model zoo init paths go through."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    return jax.jit(model.init)(rng, *args)["params"]
 
 
 def num_groups(channels: int, max_groups: int) -> int:

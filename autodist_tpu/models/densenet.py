@@ -105,7 +105,8 @@ def init_params(config: DenseNet121Config, rng=None, image_size: int = 224):
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = DenseNet(config)
     images = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
-    return model, model.init(rng, images)["params"]
+    from autodist_tpu.models.common import jit_init
+    return model, jit_init(model, images, rng=rng)
 
 
 def synthetic_batch(config: DenseNet121Config, batch_size: int,

@@ -137,7 +137,8 @@ def init_params(config: LSTMLMConfig, rng: Optional[jax.Array] = None,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = LSTMLMWithHead(config)
     tokens = jnp.zeros((batch_size, 8), jnp.int32)
-    return model, model.init(rng, tokens)["params"]
+    from autodist_tpu.models.common import jit_init
+    return model, jit_init(model, tokens, rng=rng)
 
 
 def synthetic_batch(config: LSTMLMConfig, batch_size: int, seq_len: int,

@@ -178,7 +178,8 @@ def init_params(config: MoETransformerLMConfig, rng: Optional[jax.Array] = None,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = MoETransformerLM(config)
     tokens = jnp.zeros((batch_size, min(8, config.max_len)), jnp.int32)
-    return model, model.init(rng, tokens)["params"]
+    from autodist_tpu.models.common import jit_init
+    return model, jit_init(model, tokens, rng=rng)
 
 
 def synthetic_batch(config: MoETransformerLMConfig, batch_size: int, seq_len: int,

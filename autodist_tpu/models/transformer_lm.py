@@ -175,8 +175,8 @@ def init_params(config: TransformerLMConfig, rng: Optional[jax.Array] = None,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = TransformerLM(config)
     tokens = jnp.zeros((batch_size, min(8, config.max_len)), jnp.int32)
-    variables = model.init(rng, tokens)
-    return model, variables["params"]
+    from autodist_tpu.models.common import jit_init
+    return model, jit_init(model, tokens, rng=rng)
 
 
 def synthetic_batch(config: TransformerLMConfig, batch_size: int, seq_len: int,

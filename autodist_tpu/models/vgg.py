@@ -43,7 +43,8 @@ def make_loss_fn(model: VGG16) -> Callable:
 def init_params(model: VGG16, rng=None, image_size: int = 224):
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     images = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
-    return model.init(rng, images)["params"]
+    from autodist_tpu.models.common import jit_init
+    return jit_init(model, images, rng=rng)
 
 
 def synthetic_batch(num_classes: int, batch_size: int, image_size: int = 224,

@@ -47,8 +47,9 @@ def main(argv=None):
 
     model = bert.Bert(cfg)
     batch = bert.synthetic_batch(cfg, batch_size, args.seq_len)
-    params = model.init(jax.random.PRNGKey(0), jnp.asarray(batch["tokens"]),
-                        jnp.asarray(batch["token_types"]))["params"]
+    from autodist_tpu.models.common import jit_init
+    params = jit_init(model, jnp.asarray(batch["tokens"]),
+                      jnp.asarray(batch["token_types"]))
     loss_fn = bert.make_mlm_loss_fn(model)
 
     ad = AutoDist(args.resource_spec, AllReduce(compressor="HorovodCompressor"))

@@ -35,8 +35,8 @@ def main(argv=None):
     model = ncf.NeuMF(cfg)
     batch = ncf.synthetic_batch(cfg, batch_size)
     import jax.numpy as jnp
-    params = model.init(jax.random.PRNGKey(0), jnp.asarray(batch["users"]),
-                        jnp.asarray(batch["items"]))["params"]
+    from autodist_tpu.models.common import jit_init
+    params = jit_init(model, jnp.asarray(batch["users"]), jnp.asarray(batch["items"]))
     loss_fn = ncf.make_loss_fn(model)
 
     ad = AutoDist(args.resource_spec, Parallax())

@@ -46,7 +46,7 @@ def main(epochs: int = 5, batch_size: int = 64):
     ad = AutoDist(strategy_builder=PSLoadBalancing())
     with ad.scope():
         model = SmallCNN()
-        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
 
         def loss_fn(p, batch):
             logits = model.apply({"params": p}, batch["images"])

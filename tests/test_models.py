@@ -69,7 +69,7 @@ def test_resnet_tiny_trains():
 def test_vgg_tiny_trains_partitioned_ps():
     model = vgg.VGG16(num_classes=10, dtype=jnp.float32)
     images = jnp.zeros((2, 32, 32, 3))
-    params = model.init(jax.random.PRNGKey(0), images)["params"]
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), images)["params"]
     loss_fn = vgg.make_loss_fn(model)
     rng = np.random.RandomState(0)
     batch = {"images": rng.randn(8, 32, 32, 3).astype(np.float32),
@@ -85,7 +85,7 @@ def test_bert_tiny_mlm_trains():
                           d_ff=64, max_len=64, dtype=jnp.float32)
     model = bert.Bert(cfg)
     batch = bert.synthetic_batch(cfg, batch_size=8, seq_len=16, n_predictions=4)
-    params = model.init(jax.random.PRNGKey(0), jnp.asarray(batch["tokens"]),
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.asarray(batch["tokens"]),
                         jnp.asarray(batch["token_types"]))["params"]
     loss_fn = bert.make_mlm_loss_fn(model)
     ad = AutoDist(strategy_builder=AllReduce())
@@ -98,7 +98,7 @@ def test_ncf_trains_parallax_sparse():
     cfg = ncf.NeuMFConfig(num_users=64, num_items=32, mf_dim=8, mlp_dims=(16, 8))
     model = ncf.NeuMF(cfg)
     batch = ncf.synthetic_batch(cfg, batch_size=16)
-    params = model.init(jax.random.PRNGKey(0), jnp.asarray(batch["users"]),
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.asarray(batch["users"]),
                         jnp.asarray(batch["items"]))["params"]
     loss_fn = ncf.make_loss_fn(model)
     ad = AutoDist(strategy_builder=Parallax())
