@@ -169,7 +169,8 @@ class AutoDist:
                                    example_batch: Any = None,
                                    sparse_names: Optional[Sequence[str]] = None,
                                    has_aux: bool = False,
-                                   num_workers: Optional[int] = None) -> DistributedRunner:
+                                   num_workers: Optional[int] = None,
+                                   accumulation_steps: int = 1) -> DistributedRunner:
         """Compile the strategy for this model and return the runner
         (reference autodist.py:191-198 returned the wrapped session).
 
@@ -192,6 +193,12 @@ class AutoDist:
         compiled = self._compile(model_spec)
         from autodist_tpu.parallel.plan import ShardingPlan
         plan = ShardingPlan.from_strategy(compiled, model_spec)
+        if plan.is_async and accumulation_steps > 1:
+            # Before _setup: failing after Cluster.start() would leave launched
+            # worker processes behind on a call that returns nothing.
+            raise ValueError(
+                "accumulation_steps > 1 is a synchronous-runner feature; the "
+                "async/bounded-stale regime steps micro-batches as ordinary steps")
         self._setup(strategy, async_mode=plan.is_async)
         if plan.is_async:
             from autodist_tpu.parallel.staleness import AsyncPSRunner
@@ -211,7 +218,8 @@ class AutoDist:
             self._session = runner  # _teardown closes its transport endpoints
             return runner
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
-                                 has_aux=has_aux, plan=plan)
+                                 has_aux=has_aux, plan=plan,
+                                 accumulation_steps=accumulation_steps)
 
     def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
         if sparse_names is not None:
@@ -223,7 +231,7 @@ class AutoDist:
     # ----------------------------------------------------------------- function
     def function(self, loss_fn: Callable, params: Any, optimizer,
                  example_batch: Any = None, sparse_names: Optional[Sequence[str]] = None,
-                 has_aux: bool = False) -> Callable:
+                 has_aux: bool = False, accumulation_steps: int = 1) -> Callable:
         """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
         internally (reference autodist.py:252-289 cached a built runner the same
         way: first call builds, later calls reuse).
@@ -233,7 +241,8 @@ class AutoDist:
         one slot per launched process, or a single slot for single-node runs (an
         in-process phantom worker that never steps would deadlock the gate)."""
         runner = self.create_distributed_session(
-            loss_fn, params, optimizer, example_batch, sparse_names, has_aux)
+            loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
+            accumulation_steps=accumulation_steps)
         state = runner.init(params)
 
         def step(batch, fetches=None):

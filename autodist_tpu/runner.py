@@ -47,6 +47,31 @@ def place_host_value(leaf, sharding) -> jax.Array:
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
+@jax.tree_util.register_pytree_node_class
+class MicroBatched:
+    """Marker wrapping a batch leaf laid out ``[accum_steps, micro_batch, ...]``.
+
+    Produced by ``shard_batch`` when gradient accumulation is on; the step scans
+    axis 0. Being a pytree *node* (not a bare array) makes "which leaves are
+    micro-batched" part of the jit cache key, so a batch structure change can
+    never silently reuse a stale compiled step.
+    """
+
+    def __init__(self, value):
+        self.value = value
+
+    def tree_flatten(self):
+        return (self.value,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+
+def _is_micro(leaf) -> bool:
+    return isinstance(leaf, MicroBatched)
+
+
 @dataclasses.dataclass
 class TrainState:
     """One training step's carried state (a pytree)."""
@@ -76,12 +101,16 @@ class DistributedRunner:
 
     def __init__(self, compiled_strategy, model_spec: ModelSpec, loss_fn: Callable,
                  optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
-                 donate_state: bool = True, plan: Optional[ShardingPlan] = None):
+                 donate_state: bool = True, plan: Optional[ShardingPlan] = None,
+                 accumulation_steps: int = 1):
+        if accumulation_steps < 1:
+            raise ValueError("accumulation_steps must be >= 1")
         self._model_spec = model_spec
         self._loss_fn = loss_fn
         self._optimizer = optimizer
         self._has_aux = has_aux
         self._donate = donate_state
+        self._accum = accumulation_steps
         self.plan = plan if plan is not None \
             else ShardingPlan.from_strategy(compiled_strategy, model_spec)
         self.mesh = mesh if mesh is not None else self._mesh_from_plan()
@@ -144,11 +173,50 @@ class DistributedRunner:
 
     # -------------------------------------------------------------------- step
     def _build_step(self, fetch_fn: Optional[Callable] = None):
+        import jax.numpy as jnp
+
         optimizer = self._optimizer
         grad_fn = self._grad_fn
+        accum = self._accum
+
+        def accumulate(params, batch, ef_state):
+            """Gradient accumulation: scan grad_fn over the micro axis, summing
+            gradients and threading error-feedback state; one optimizer update per
+            outer step. Micro-batches are equal-sized, so the mean of per-micro
+            (already data-synced) gradients equals the full-batch gradient for
+            mean-reduced losses — value-exact vs one big batch."""
+            def select(i):
+                return jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l.value, i, axis=0, keepdims=False) if _is_micro(l) else l,
+                    batch, is_leaf=_is_micro)
+
+            def micro(carry, i):
+                gsum, ef = carry
+                grads, loss, aux, ef = grad_fn(params, select(i), ef)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (gsum, ef), (loss, aux)
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (gsum, ef_state), (losses, auxes) = jax.lax.scan(
+                micro, (zeros, ef_state), jnp.arange(accum))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+            # Aux contraction matches the accum=1 shapes: scalar aux (stacked to
+            # [k]) averages across micros; per-example aux (stacked to
+            # [k, B/k, ...]) folds back to [B, ...] — same examples, same params,
+            # so the values are identical to the full-batch evaluation.
+            aux = jax.tree_util.tree_map(
+                lambda a: jnp.mean(a, axis=0) if a.ndim == 1
+                else a.reshape((-1,) + a.shape[2:]), auxes)
+            return grads, jnp.mean(losses), aux, ef_state
 
         def step_fn(state: TrainState, batch: PyTree):
-            grads, loss, aux, ef_state = grad_fn(state.params, batch, state.ef_state)
+            if accum > 1:
+                grads, loss, aux, ef_state = accumulate(state.params, batch,
+                                                        state.ef_state)
+            else:
+                grads, loss, aux, ef_state = grad_fn(state.params, batch,
+                                                     state.ef_state)
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(step=state.step + 1, params=params,
@@ -160,7 +228,16 @@ class DistributedRunner:
             # per-example outputs come back as the (logically concatenated)
             # global batch-sharded array, scalars as the replicated value the
             # reference took from the master replica.
-            fetched = fetch_fn(state.params, batch) if fetch_fn is not None else ()
+            if fetch_fn is not None:
+                # Fetches see the logical batch: micro-batched leaves fold back to
+                # [B, ...] (row-major reshape restores the original example order).
+                logical = jax.tree_util.tree_map(
+                    lambda l: l.value.reshape((-1,) + l.value.shape[2:])
+                    if _is_micro(l) else l,
+                    batch, is_leaf=_is_micro)
+                fetched = fetch_fn(state.params, logical)
+            else:
+                fetched = ()
             return new_state, (loss, aux, fetched)
 
         donate = (0,) if self._donate else ()
@@ -185,14 +262,33 @@ class DistributedRunner:
     def shard_batch(self, batch: PyTree) -> PyTree:
         """Feed remapping: split batch leaves across data replicas, duplicate the
         rest (reference remapper.py:81-123 semantics, with the polymorphic dim now
-        'leading dim divisible by dp_size')."""
+        'leading dim divisible by dp_size').
+
+        With gradient accumulation (``accumulation_steps=k``), splittable leaves
+        are additionally laid out ``[k, B/k, ...]`` (wrapped in ``MicroBatched``)
+        so the compiled step can scan micro-batches; the reshape happens on the
+        host, before placement, so it moves no device data."""
         dp = synchronization.mesh_dp_size(self.mesh)
+        k = self._accum
 
         def put(leaf):
+            if _is_micro(leaf):
+                return leaf  # already laid out by a previous shard_batch
             shape = getattr(leaf, "shape", None)
             if shape is None:
                 leaf = np.asarray(leaf)
                 shape = leaf.shape
+            if k > 1 and len(shape) >= 1 and shape[0] % (k * dp) == 0:
+                micro = leaf.reshape((k, shape[0] // k) + tuple(shape[1:]))
+                spec = P(None, *self.plan.batch_pspec(len(shape)))
+                return MicroBatched(
+                    place_host_value(micro, NamedSharding(self.mesh, spec)))
+            if k > 1 and len(shape) >= 1 and shape[0] % dp == 0:
+                raise ValueError(
+                    f"Batch leaf with leading dim {shape[0]} splits across "
+                    f"{dp} data replicas but not into accumulation_steps={k} "
+                    f"micro-batches; make the global batch divisible by "
+                    f"{k * dp} (or drop accumulation)")
             if len(shape) >= 1 and shape[0] % dp == 0:
                 spec = self.plan.batch_pspec(len(shape))
             else:
@@ -202,7 +298,7 @@ class DistributedRunner:
                 return leaf  # already resident with the right layout — no transfer
             return place_host_value(leaf, sharding)
 
-        return jax.tree_util.tree_map(put, batch)
+        return jax.tree_util.tree_map(put, batch, is_leaf=_is_micro)
 
     def logical_params(self, state_or_params) -> PyTree:
         """The parameter tree at its original (user-facing, unpadded) shapes."""
