@@ -201,13 +201,18 @@ class DistributedRunner:
             (gsum, ef_state), (losses, auxes) = jax.lax.scan(
                 micro, (zeros, ef_state), jnp.arange(accum))
             grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
-            # Aux contraction matches the accum=1 shapes: scalar aux (stacked to
-            # [k]) averages across micros; per-example aux (stacked to
-            # [k, B/k, ...]) folds back to [B, ...] — same examples, same params,
-            # so the values are identical to the full-batch evaluation.
+            # Aux contraction matches the accum=1 shapes: per-example aux — leading
+            # dim == the micro-batch size — folds back to [B, ...] (same examples,
+            # same params, so values are identical to full-batch evaluation);
+            # everything else (scalars, per-class vectors, ...) averages across
+            # micros. A non-per-example aux whose leading dim happens to equal the
+            # micro-batch size is indistinguishable and gets folded.
+            micro_b = next((l.value.shape[1] for l in jax.tree_util.tree_leaves(
+                batch, is_leaf=_is_micro) if _is_micro(l)), None)
             aux = jax.tree_util.tree_map(
-                lambda a: jnp.mean(a, axis=0) if a.ndim == 1
-                else a.reshape((-1,) + a.shape[2:]), auxes)
+                lambda a: a.reshape((-1,) + a.shape[2:])
+                if a.ndim >= 2 and a.shape[1] == micro_b
+                else jnp.mean(a, axis=0), auxes)
             return grads, jnp.mean(losses), aux, ef_state
 
         def step_fn(state: TrainState, batch: PyTree):
@@ -271,6 +276,20 @@ class DistributedRunner:
         dp = synchronization.mesh_dp_size(self.mesh)
         k = self._accum
 
+        # Which leaves are *batch* leaves for micro-splitting: those whose leading
+        # dim equals the global batch size, taken as the largest leading dim in the
+        # pytree. Auxiliary leaves (per-class weights, small constants) keep the
+        # plain accum=1 placement — splitting them into micro-slices would change
+        # the values the loss function sees.
+        batch_dim = 0
+        if k > 1:
+            for leaf in jax.tree_util.tree_leaves(batch, is_leaf=_is_micro):
+                if _is_micro(leaf):
+                    continue
+                shape = getattr(leaf, "shape", None) or np.asarray(leaf).shape
+                if len(shape) >= 1:
+                    batch_dim = max(batch_dim, shape[0])
+
         def put(leaf):
             if _is_micro(leaf):
                 return leaf  # already laid out by a previous shard_batch
@@ -278,17 +297,17 @@ class DistributedRunner:
             if shape is None:
                 leaf = np.asarray(leaf)
                 shape = leaf.shape
-            if k > 1 and len(shape) >= 1 and shape[0] % (k * dp) == 0:
+            if k > 1 and len(shape) >= 1 and shape[0] == batch_dim:
+                if shape[0] % (k * dp) != 0:
+                    raise ValueError(
+                        f"Global batch {shape[0]} is not divisible into "
+                        f"accumulation_steps={k} micro-batches over {dp} data "
+                        f"replicas; make it divisible by {k * dp} (or drop "
+                        f"accumulation)")
                 micro = leaf.reshape((k, shape[0] // k) + tuple(shape[1:]))
                 spec = P(None, *self.plan.batch_pspec(len(shape)))
                 return MicroBatched(
                     place_host_value(micro, NamedSharding(self.mesh, spec)))
-            if k > 1 and len(shape) >= 1 and shape[0] % dp == 0:
-                raise ValueError(
-                    f"Batch leaf with leading dim {shape[0]} splits across "
-                    f"{dp} data replicas but not into accumulation_steps={k} "
-                    f"micro-batches; make the global batch divisible by "
-                    f"{k * dp} (or drop accumulation)")
             if len(shape) >= 1 and shape[0] % dp == 0:
                 spec = self.plan.batch_pspec(len(shape))
             else:
@@ -343,9 +362,13 @@ class DistributedRunner:
         if not const.ENV.AUTODIST_DUMP_GRAPHS.val:
             return
         from autodist_tpu.utils import tracing
+        # The user's loss fn sees the logical batch: fold micro-batched leaves back.
+        logical_batch = jax.tree_util.tree_map(
+            lambda l: l.value.reshape((-1,) + l.value.shape[2:]) if _is_micro(l)
+            else l, sharded_batch, is_leaf=_is_micro)
         with self.mesh:
             tracing.dump_stage("train_step", "0-original", self._step_loss_fn,
-                               state.params, sharded_batch)
+                               state.params, logical_batch)
             tracing.dump_stage("train_step", "1-distributed",
                                lambda s, b: step_fn(s, b), state, sharded_batch)
 

@@ -48,6 +48,18 @@ def parse_ar_options(chunk_size: int, all_reduce_spec: str, compressor: str):
     return chunk_size, _SPECS[all_reduce_spec], _COMPRESSORS[compressor]
 
 
+def fill_ar_synchronizer(node, *, spec: int, compressor: int, group: int,
+                         power_sgd_rank: int = 2):
+    """Fill one node's AllReduceSynchronizer — the single emission point, so a new
+    proto field propagates to every builder that emits AR nodes."""
+    ar = node.all_reduce_synchronizer
+    ar.spec = spec
+    ar.compressor = compressor
+    if compressor == strategy_pb2.AllReduceSynchronizer.POWER_SGD:
+        ar.power_sgd_rank = power_sgd_rank
+    ar.group = group
+
+
 def fill_ar_node_configs(strategy: Strategy, model_spec: ModelSpec, *, spec: int,
                          compressor: int, chunk_size: int, power_sgd_rank: int = 2):
     """Emit one AllReduceSynchronizer node per trainable parameter — the shared
@@ -55,12 +67,8 @@ def fill_ar_node_configs(strategy: Strategy, model_spec: ModelSpec, *, spec: int
     for i, pspec in enumerate(model_spec.trainable.values()):
         node = strategy.proto.node_config.add(var_name=pspec.name)
         node.sparse = pspec.sparse
-        ar = node.all_reduce_synchronizer
-        ar.spec = spec
-        ar.compressor = compressor
-        if compressor == strategy_pb2.AllReduceSynchronizer.POWER_SGD:
-            ar.power_sgd_rank = power_sgd_rank
-        ar.group = i // chunk_size
+        fill_ar_synchronizer(node, spec=spec, compressor=compressor,
+                             group=i // chunk_size, power_sgd_rank=power_sgd_rank)
 
 
 class AllReduce(StrategyBuilder):

@@ -148,6 +148,54 @@ def test_aux_shapes_match_accum1():
     np.testing.assert_allclose(a4["mean_abs"], a1["mean_abs"], rtol=2e-6, atol=2e-6)
 
 
+def test_non_batch_leaves_stay_whole():
+    """Auxiliary leaves (per-class weights, small constants) must not be
+    micro-sliced: only leaves at the global batch size scan."""
+    cw = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"] + p["b"]
+        return jnp.mean((b["y"] - pred) ** 2) * jnp.sum(b["cw"])
+
+    def run(accum):
+        ad = AutoDist(strategy_builder=AllReduce())
+        batch = dict(_dense_data(), cw=cw, three=np.ones((3,), np.float32))
+        runner = ad.create_distributed_session(
+            loss_fn, _dense_params(), optax.sgd(0.01), example_batch=batch,
+            accumulation_steps=accum)
+        state = runner.init(_dense_params())
+        state, loss = runner.run(state, batch)
+        return float(loss), jax.device_get(runner.logical_params(state))
+
+    (l1, p1), (l4, p4) = run(1), run(4)
+    assert l1 == pytest.approx(l4, rel=1e-6)
+    for k in p1:
+        np.testing.assert_allclose(p4[k], p1[k], rtol=2e-6, atol=2e-6)
+
+
+def test_vector_aux_averages_not_concats():
+    """A fixed-size vector aux (not per-example) keeps its shape under accum."""
+    def loss_with_aux(p, b):
+        pred = b["x"] @ p["w"] + p["b"]
+        err = (b["y"] - pred)[:, 0]
+        return jnp.mean(err ** 2), jnp.stack([jnp.mean(err), jnp.max(err),
+                                              jnp.min(err)])
+
+    def run(accum):
+        ad = AutoDist(strategy_builder=AllReduce())
+        runner = ad.create_distributed_session(
+            loss_with_aux, _dense_params(), optax.sgd(0.1),
+            example_batch=_dense_data(), has_aux=True, accumulation_steps=accum)
+        state = runner.init(_dense_params())
+        _, (loss, aux) = runner.run(state, _dense_data())
+        return jax.device_get(aux)
+
+    a1, a4 = run(1), run(4)
+    assert a1.shape == a4.shape == (3,)
+    # Mean-of-micro-means equals the full mean for equal micro sizes.
+    np.testing.assert_allclose(a4[0], a1[0], rtol=2e-6, atol=2e-6)
+
+
 def test_indivisible_batch_raises():
     ad = AutoDist(strategy_builder=AllReduce())
     runner = ad.create_distributed_session(
