@@ -20,11 +20,12 @@ from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
 from autodist_tpu.strategy.pipeline_strategy import Pipeline
 from autodist_tpu.strategy.sequence_parallel_strategy import SequenceParallel
 from autodist_tpu.strategy.auto_strategy import AutoStrategy
+from autodist_tpu.strategy.tuner import TuneResult, tune_strategy
 
 __all__ = [
     "Strategy", "StrategyBuilder", "StrategyCompiler",
     "PS", "PSLoadBalancing", "byte_size_load_fn", "PartitionedPS",
     "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
     "RandomAxisPartitionAR", "Parallax", "ExpertParallel", "Pipeline",
-    "SequenceParallel", "AutoStrategy",
+    "SequenceParallel", "AutoStrategy", "tune_strategy", "TuneResult",
 ]

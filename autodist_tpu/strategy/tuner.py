@@ -1,0 +1,138 @@
+"""Empirical strategy tuning: measure candidate builders, pick the fastest.
+
+Complements :class:`AutoStrategy` (the analytic model): where the cost model
+predicts, the tuner *measures* — each candidate strategy is compiled and run for
+a few steps on the real model, batch, and devices, and the winner is whatever
+was actually fastest. This is the measurement loop the reference's docs leave to
+the user (its performance guide tunes ``chunk_size`` per model by hand,
+``examples/benchmark/imagenet.py:150-160``), packaged as an API.
+
+Candidates that fail to build or run (OOM, unsupported model shape) are
+recorded and skipped rather than aborting the search.
+"""
+
+import dataclasses
+import gc
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.utils import logging
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    builder: StrategyBuilder
+    name: str
+    steps_per_sec: Optional[float]    # None = failed
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TuneResult:
+    best: StrategyBuilder
+    results: List[CandidateResult]
+
+    def report(self) -> str:
+        """Human-readable ranking table."""
+        rows = sorted(self.results,
+                      key=lambda r: -(r.steps_per_sec or float("-inf")))
+        width = max(len(r.name) for r in rows)
+        lines = []
+        for r in rows:
+            if r.steps_per_sec is None:
+                lines.append(f"{r.name:<{width}}  FAILED: {r.error}")
+            else:
+                marker = "  <- best" if r.builder is self.best else ""
+                lines.append(f"{r.name:<{width}}  {r.steps_per_sec:8.2f} steps/s"
+                             f"{marker}")
+        return "\n".join(lines)
+
+
+def _default_candidates(has_sparse: bool) -> List[StrategyBuilder]:
+    from autodist_tpu.strategy import (AllReduce, AutoStrategy, Parallax,
+                                       PSLoadBalancing)
+    cands: List[StrategyBuilder] = [AllReduce(), PSLoadBalancing(), AutoStrategy()]
+    if has_sparse:
+        cands.insert(2, Parallax())
+    return cands
+
+
+def tune_strategy(loss_fn: Callable, params: Any, optimizer,
+                  example_batch: Any,
+                  candidates: Optional[Sequence[StrategyBuilder]] = None,
+                  resource_spec: Optional[ResourceSpec] = None,
+                  warmup_steps: int = 2, measure_steps: int = 8,
+                  sparse_names: Optional[Sequence[str]] = None) -> TuneResult:
+    """Measure each candidate builder on the real (model, batch, devices).
+
+    Returns the fastest builder plus the full ranking; pass ``result.best`` to
+    :class:`AutoDist`. Each candidate gets ``warmup_steps`` (compile + first
+    dispatch) then ``measure_steps`` timed steps, fenced by a host read of the
+    loss. State and compiled executables are dropped between candidates.
+    """
+    from autodist_tpu.autodist import (AutoDist, get_default_autodist,
+                                       set_default_autodist)
+    from autodist_tpu.model_spec import ModelSpec
+
+    if warmup_steps < 1:
+        raise ValueError("warmup_steps must be >= 1 (the timed loop needs a "
+                         "compiled, pipeline-fenced step to start from)")
+    if candidates is None:
+        spec = (ModelSpec(params, sparse_names=sparse_names)
+                if sparse_names is not None
+                else ModelSpec.from_loss_fn(loss_fn, params, example_batch))
+        has_sparse = any(p.sparse for p in spec.trainable.values())
+        candidates = _default_candidates(has_sparse)
+
+    prior_default = get_default_autodist()  # candidates must not leak as default
+    results: List[CandidateResult] = []
+    try:
+        for builder in candidates:
+            name = type(builder).__name__
+            ad = None
+            try:
+                ad = AutoDist(resource_spec, builder)
+                runner = ad.create_distributed_session(
+                    loss_fn, params, optimizer, example_batch=example_batch,
+                    sparse_names=sparse_names)
+                state = runner.init(params)
+                batch = runner.shard_batch(example_batch)
+                for _ in range(warmup_steps):
+                    state, loss = runner.run(state, batch)
+                float(loss)  # compile + pipeline fence before the clock starts
+                t0 = time.perf_counter()
+                for _ in range(measure_steps):
+                    state, loss = runner.run(state, batch)
+                float(loss)  # completion fence (device->host read)
+                rate = measure_steps / (time.perf_counter() - t0)
+                results.append(CandidateResult(builder, name, rate))
+                logging.info("tune_strategy %s: %.2f steps/s", name, rate)
+            except Exception as e:  # noqa: BLE001 — a candidate OOMing must not abort
+                results.append(
+                    CandidateResult(builder, name, None, f"{type(e).__name__}: {e}"))
+                logging.warning("tune_strategy %s failed: %s", name, e)
+            finally:
+                # Tear down anything the candidate launched (clusters, PS
+                # transports) and drop state + executables before the next
+                # candidate is timed.
+                if ad is not None:
+                    try:
+                        ad._teardown()
+                    except Exception as e:  # noqa: BLE001
+                        logging.warning("tune_strategy %s teardown: %s", name, e)
+                state = batch = runner = ad = loss = None  # noqa: F841
+                gc.collect()
+    finally:
+        set_default_autodist(prior_default)
+
+    ranked = [r for r in results if r.steps_per_sec is not None]
+    if not ranked:
+        raise RuntimeError(
+            "tune_strategy: every candidate failed:\n" +
+            "\n".join(f"  {r.name}: {r.error}" for r in results))
+    best = max(ranked, key=lambda r: r.steps_per_sec)
+    logging.info("tune_strategy winner: %s (%.2f steps/s)", best.name,
+                 best.steps_per_sec)
+    return TuneResult(best=best.builder, results=results)
