@@ -22,7 +22,8 @@ Layer map (mirrors reference SURVEY.md §1, re-targeted):
 
 from autodist_tpu.version import __version__
 
-__all__ = ["AutoDist", "get_default_autodist", "ResourceSpec", "__version__"]
+__all__ = ["AutoDist", "get_default_autodist", "ResourceSpec", "train",
+           "__version__"]
 
 
 def __getattr__(name):  # PEP 562 lazy imports to keep `import autodist_tpu` light
@@ -32,4 +33,7 @@ def __getattr__(name):  # PEP 562 lazy imports to keep `import autodist_tpu` lig
     if name == "ResourceSpec":
         from autodist_tpu.resource_spec import ResourceSpec
         return ResourceSpec
+    if name == "train":
+        from autodist_tpu.training import train
+        return train
     raise AttributeError(f"module 'autodist_tpu' has no attribute {name!r}")

@@ -64,7 +64,8 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                   candidates: Optional[Sequence[StrategyBuilder]] = None,
                   resource_spec: Optional[ResourceSpec] = None,
                   warmup_steps: int = 2, measure_steps: int = 8,
-                  sparse_names: Optional[Sequence[str]] = None) -> TuneResult:
+                  sparse_names: Optional[Sequence[str]] = None,
+                  has_aux: bool = False) -> TuneResult:
     """Measure each candidate builder on the real (model, batch, devices).
 
     Returns the fastest builder plus the full ranking; pass ``result.best`` to
@@ -96,15 +97,20 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                 ad = AutoDist(resource_spec, builder)
                 runner = ad.create_distributed_session(
                     loss_fn, params, optimizer, example_batch=example_batch,
-                    sparse_names=sparse_names)
+                    sparse_names=sparse_names, has_aux=has_aux)
                 state = runner.init(params)
+                # Pre-place the batch: run()'s resident-array check then makes the
+                # per-step shard a no-op, so the timed loop measures the strategy,
+                # not the host link.
                 batch = runner.shard_batch(example_batch)
                 for _ in range(warmup_steps):
-                    state, loss = runner.run(state, batch)
+                    state, fetched = runner.run(state, batch)
+                loss = fetched[0] if has_aux else fetched
                 float(loss)  # compile + pipeline fence before the clock starts
                 t0 = time.perf_counter()
                 for _ in range(measure_steps):
-                    state, loss = runner.run(state, batch)
+                    state, fetched = runner.run(state, batch)
+                loss = fetched[0] if has_aux else fetched
                 float(loss)  # completion fence (device->host read)
                 rate = measure_steps / (time.perf_counter() - t0)
                 results.append(CandidateResult(builder, name, rate))

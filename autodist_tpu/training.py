@@ -1,0 +1,114 @@
+"""Training loop with automatic checkpoint/resume.
+
+The reference left the loop to user scripts (session.run loops, Keras fit) and
+proved resumability with its NFS saver case — chief-gated saves on a shared
+filesystem (``tests/integration/cases/c10.py:1-12``). This is that contract as
+an API: periodic chief-gated saves under original names, automatic resume from
+the latest checkpoint, throughput metering, and a final save — so a preempted
+run restarted with the same command continues where it stopped.
+"""
+
+from typing import Any, Callable, Iterable, Optional, Union
+
+from autodist_tpu import const
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.runner import TrainState
+from autodist_tpu.utils import logging
+from autodist_tpu.utils.metrics import ThroughputMeter
+
+PyTree = Any
+
+
+def train(runner, params: PyTree,
+          batches: Union[Callable[[int], PyTree], Iterable[PyTree]],
+          steps: int,
+          checkpoint_dir: Optional[str] = None,
+          checkpoint_name: str = "model",
+          save_every: int = 1000,
+          max_to_keep: int = 5,
+          log_every: int = 100,
+          batch_size: Optional[int] = None,
+          is_chief: Optional[bool] = None,
+          resume: bool = True,
+          on_metrics: Optional[Callable[[int, float, float], None]] = None) -> TrainState:
+    """Run ``steps`` global steps, checkpointing and resuming automatically.
+
+    ``batches``: either ``fn(step_index) -> batch`` or an iterable of batches
+    (exhaustion ends the run early). ``save_every``/final saves happen on the
+    chief only (every process restores, so all resume in lockstep — the c10
+    shared-filesystem protocol). ``on_metrics(step, loss, rate)`` fires every
+    ``log_every`` steps. Returns the final :class:`TrainState`.
+    """
+    if is_chief is None:
+        is_chief = const.is_chief_process()
+    saver = Saver(max_to_keep=max_to_keep) if checkpoint_dir else None
+    prefix_base = f"{checkpoint_dir}/{checkpoint_name}" if checkpoint_dir else None
+
+    state = None
+    if saver is not None and resume:
+        latest = Saver.latest_checkpoint(checkpoint_dir)
+        if latest is not None:
+            state = saver.restore(latest, runner=runner)
+            logging.info("train: resumed from %s at step %d", latest,
+                         int(state.step))
+    if state is None:
+        state = runner.init(params)
+
+    next_batch = batches if callable(batches) else None
+    batch_iter = iter(batches) if next_batch is None else None
+
+    start = int(state.step)
+    if batch_iter is not None and start > 0:
+        # Resume with an iterable: fast-forward so step i still consumes batch i —
+        # replaying from item 0 would retrain on already-seen data and break the
+        # identical-resume contract.
+        logging.info("train: fast-forwarding batch iterator by %d consumed steps",
+                     start)
+        for _ in range(start):
+            try:
+                next(batch_iter)
+            except StopIteration:
+                return state
+    meter = None
+    loss = None
+    for step_i in range(start, steps):
+        if next_batch is not None:
+            batch = next_batch(step_i)
+        else:
+            try:
+                batch = next(batch_iter)
+            except StopIteration:
+                logging.info("train: batch iterator exhausted at step %d", step_i)
+                break
+        state, fetched = runner.run(state, batch)
+        loss = fetched[0] if isinstance(fetched, tuple) else fetched
+        if meter is None and log_every:
+            # Lazily sized: the first batch fixes the example count per step.
+            n = batch_size
+            if n is None:
+                leaves = [l for l in _leaves(batch) if getattr(l, "ndim", 0) >= 1]
+                n = max((l.shape[0] for l in leaves), default=1)
+            meter = ThroughputMeter(batch_size=n, log_every=log_every)
+        if meter is not None:
+            # The meter syncs (device->host read of the loss) only at its period
+            # boundaries — one boundary per log_every steps, not per step — and
+            # excludes its warmup step, so boundaries land at 1 + k*log_every
+            # local steps.
+            rate = meter.step(sync=loss)
+            if rate is not None:
+                logging.info("train: step %d loss %.4f %.1f examples/s",
+                             step_i + 1, float(loss), rate)
+                if on_metrics is not None:
+                    on_metrics(step_i + 1, float(loss), rate)
+        if (saver is not None and is_chief and save_every
+                and (step_i + 1) % save_every == 0 and step_i + 1 < steps):
+            saver.save(state, prefix_base, runner=runner)
+
+    if saver is not None and is_chief and int(state.step) > start:
+        saver.save(state, prefix_base, runner=runner)
+    return state
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)

@@ -60,6 +60,17 @@ def test_tuner_all_failing_raises():
                       candidates=[ExplodingBuilder()])
 
 
+def test_tuner_with_aux_loss():
+    def loss_aux(p, b):
+        err = b["y"] - (b["x"] @ p["w"] + p["b"])
+        return jnp.mean(err ** 2), {"mae": jnp.mean(jnp.abs(err))}
+
+    result = tune_strategy(loss_aux, _params(), optax.sgd(0.1), _batch(),
+                           candidates=[AllReduce(), PSLoadBalancing()],
+                           warmup_steps=1, measure_steps=2, has_aux=True)
+    assert all(r.steps_per_sec for r in result.results)
+
+
 def test_tuner_restores_default_autodist():
     from autodist_tpu import AutoDist, get_default_autodist
     mine = AutoDist(strategy_builder=AllReduce())
