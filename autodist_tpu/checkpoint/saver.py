@@ -16,8 +16,10 @@ Format: one ``<prefix>.npz`` holding ``{name: full ndarray}`` plus a JSON manife
 is saved under an ``__opt__/`` prefix, the step counter under ``__step__``.
 """
 
+import glob
 import json
 import os
+import re
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -125,22 +127,22 @@ class Saver:
         return prefix
 
     def _load_rotation_state(self, save_path: str):
-        """Seed the rotation list from the directory's ``checkpoint`` state file so
-        a restarted trainer keeps rotating checkpoints written before the restart
-        (previously the list was in-memory only and pre-restart files leaked)."""
+        """Seed the rotation list from the files on disk so a restarted trainer
+        keeps rotating checkpoints written before the restart. Scanning
+        ``<save_path>-<step>.npz`` (instead of trusting the directory's shared
+        ``checkpoint`` state file) keeps rotation per *name*: two models
+        checkpointing into one directory under different names never adopt —
+        or delete — each other's files."""
         if self._rotation_loaded:
             return
         self._rotation_loaded = True
-        state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
-        try:
-            with open(state_path) as f:
-                data = json.load(f)
-        except (OSError, ValueError):
-            return
-        prior = data.get("all", []) if isinstance(data, dict) else []
-        for prefix in prior:
-            if (isinstance(prefix, str) and prefix not in self._kept
-                    and os.path.exists(prefix + ".npz")):
+        prior = []
+        for path in glob.glob(glob.escape(save_path) + "-*.npz"):
+            m = re.fullmatch(re.escape(save_path) + r"-(\d+)\.npz", path)
+            if m:
+                prior.append((int(m.group(1)), path[:-len(".npz")]))
+        for _, prefix in sorted(prior):
+            if prefix not in self._kept:
                 self._kept.append(prefix)
 
     def _update_state_file(self, save_path: str, prefix: str):
@@ -162,12 +164,30 @@ class Saver:
 
     # ---------------------------------------------------------------- restore
     @staticmethod
-    def latest_checkpoint(directory: str) -> Optional[str]:
+    def latest_checkpoint(directory: str, name: Optional[str] = None) -> Optional[str]:
+        """Most recent checkpoint prefix in ``directory``.
+
+        With ``name``, only checkpoints saved as ``<name>-<step>`` count — the
+        directory-level ``checkpoint`` state file records whichever save ran
+        last, so a directory shared by multiple names needs the filter."""
         state_path = os.path.join(directory, _STATE_FILE)
-        if not os.path.exists(state_path):
-            return None
-        with open(state_path) as f:
-            return json.load(f).get("latest")
+        latest = None
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                latest = json.load(f).get("latest")
+        if name is None:
+            return latest
+        if latest and os.path.basename(latest).startswith(name + "-") \
+                and os.path.exists(latest + ".npz"):
+            return latest
+        # The state file points at another name's save: scan for this name's.
+        best = None
+        base = os.path.join(directory, name)
+        for path in glob.glob(glob.escape(base) + "-*.npz"):
+            m = re.fullmatch(re.escape(base) + r"-(\d+)\.npz", path)
+            if m and (best is None or int(m.group(1)) > best[0]):
+                best = (int(m.group(1)), path[:-len(".npz")])
+        return best[1] if best else None
 
     def restore_params(self, prefix: str) -> Dict[str, Any]:
         """Load the parameter tree as a nested host-numpy dict (original names)."""

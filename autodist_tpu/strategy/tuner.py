@@ -80,6 +80,8 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
     if warmup_steps < 1:
         raise ValueError("warmup_steps must be >= 1 (the timed loop needs a "
                          "compiled, pipeline-fenced step to start from)")
+    if measure_steps < 1:
+        raise ValueError("measure_steps must be >= 1")
     if candidates is None:
         spec = (ModelSpec(params, sparse_names=sparse_names)
                 if sparse_names is not None

@@ -10,6 +10,8 @@ run restarted with the same command continues where it stopped.
 
 from typing import Any, Callable, Iterable, Optional, Union
 
+import jax
+
 from autodist_tpu import const
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.runner import TrainState
@@ -46,7 +48,7 @@ def train(runner, params: PyTree,
 
     state = None
     if saver is not None and resume:
-        latest = Saver.latest_checkpoint(checkpoint_dir)
+        latest = Saver.latest_checkpoint(checkpoint_dir, name=checkpoint_name)
         if latest is not None:
             state = saver.restore(latest, runner=runner)
             logging.info("train: resumed from %s at step %d", latest,
@@ -86,9 +88,10 @@ def train(runner, params: PyTree,
             # Lazily sized: the first batch fixes the example count per step.
             n = batch_size
             if n is None:
-                leaves = [l for l in _leaves(batch) if getattr(l, "ndim", 0) >= 1]
+                leaves = [l for l in jax.tree_util.tree_leaves(batch)
+                          if getattr(l, "ndim", 0) >= 1]
                 n = max((l.shape[0] for l in leaves), default=1)
-            meter = ThroughputMeter(batch_size=n, log_every=log_every)
+            meter = ThroughputMeter(batch_size=n, log_every=log_every, log=False)
         if meter is not None:
             # The meter syncs (device->host read of the loss) only at its period
             # boundaries — one boundary per log_every steps, not per step — and
@@ -107,8 +110,3 @@ def train(runner, params: PyTree,
     if saver is not None and is_chief and int(state.step) > start:
         saver.save(state, prefix_base, runner=runner)
     return state
-
-
-def _leaves(tree):
-    import jax
-    return jax.tree_util.tree_leaves(tree)

@@ -18,11 +18,13 @@ class ThroughputMeter:
     """examples/sec (or tokens/sec) per log period plus a run average."""
 
     def __init__(self, batch_size: int, log_every: int = 100,
-                 unit: str = "examples", warmup_steps: int = 1):
+                 unit: str = "examples", warmup_steps: int = 1,
+                 log: bool = True):
         self._batch_size = batch_size
         self._log_every = log_every
         self._unit = unit
         self._warmup = warmup_steps
+        self._log = log  # False when the caller emits its own period log line
         self._step = 0
         now = time.perf_counter()
         # warmup_steps=0 means "count from construction"; otherwise these restart
@@ -60,7 +62,8 @@ class ThroughputMeter:
         if self._run_steps % self._log_every == 0:
             rate = self._log_every * self._batch_size / (now - self._period_start)
             self.history.append(rate)
-            logging.info("step %d: %.1f %s/sec", self._step, rate, self._unit)
+            if self._log:
+                logging.info("step %d: %.1f %s/sec", self._step, rate, self._unit)
             self._period_start = now
             return rate
         return None

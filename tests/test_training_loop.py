@@ -97,6 +97,29 @@ def test_iterator_resume_fast_forwards(tmp_path):
         np.testing.assert_allclose(r[k], d[k], rtol=1e-6, atol=1e-6)
 
 
+def test_two_names_share_directory_without_cross_talk(tmp_path):
+    """GAN-style: two models checkpoint into one directory under different
+    names; each resumes its own line and never rotates the other's files."""
+    ckpt = str(tmp_path / "ckpts")
+    a = train(_runner(), _params(), _batch_fn, steps=3, checkpoint_dir=ckpt,
+              checkpoint_name="gen", log_every=0)
+    b = train(_runner(), _params(), _batch_fn, steps=5, checkpoint_dir=ckpt,
+              checkpoint_name="disc", save_every=2, max_to_keep=2, log_every=0)
+    # Resume "gen" to 6: must restore gen-3 (not disc-5) and extend it.
+    a2 = train(_runner(), _params(), _batch_fn, steps=6, checkpoint_dir=ckpt,
+               checkpoint_name="gen", log_every=0)
+    assert int(a2.step) == 6
+    direct = train(_runner(), _params(), _batch_fn, steps=6, log_every=0)
+    d, r = jax.device_get(direct.params), jax.device_get(a2.params)
+    for k in d:
+        np.testing.assert_allclose(r[k], d[k], rtol=1e-6, atol=1e-6)
+    import glob
+    # disc's rotation (max_to_keep=2) never deleted gen's files.
+    assert sorted(p.split("/")[-1] for p in glob.glob(f"{ckpt}/gen-*.npz")) \
+        == ["gen-3.npz", "gen-6.npz"]
+    assert len(glob.glob(f"{ckpt}/disc-*.npz")) == 2
+
+
 def test_metrics_callback_fires():
     seen = []
     train(_runner(), _params(), _batch_fn, steps=7, log_every=3,
