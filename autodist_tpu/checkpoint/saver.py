@@ -35,6 +35,18 @@ _STEP_KEY = "__step__"
 _STATE_FILE = "checkpoint"  # directory-level latest-pointer, like TF's
 
 
+def _scan_checkpoints(base: str):
+    """``[(step, prefix)]`` for every ``<base>-<step>.npz`` on disk, step-ascending.
+    The single name-exact filename parse shared by rotation adoption and
+    name-filtered latest lookup."""
+    found = []
+    for path in glob.glob(glob.escape(base) + "-*.npz"):
+        m = re.fullmatch(re.escape(base) + r"-(\d+)\.npz", path)
+        if m:
+            found.append((int(m.group(1)), path[:-len(".npz")]))
+    return sorted(found)
+
+
 def _flatten_named(tree: PyTree) -> Dict[str, np.ndarray]:
     """Flatten a pytree to {original-name: full host ndarray}.
 
@@ -136,12 +148,7 @@ class Saver:
         if self._rotation_loaded:
             return
         self._rotation_loaded = True
-        prior = []
-        for path in glob.glob(glob.escape(save_path) + "-*.npz"):
-            m = re.fullmatch(re.escape(save_path) + r"-(\d+)\.npz", path)
-            if m:
-                prior.append((int(m.group(1)), path[:-len(".npz")]))
-        for _, prefix in sorted(prior):
+        for _, prefix in _scan_checkpoints(save_path):
             if prefix not in self._kept:
                 self._kept.append(prefix)
 
@@ -177,17 +184,15 @@ class Saver:
                 latest = json.load(f).get("latest")
         if name is None:
             return latest
-        if latest and os.path.basename(latest).startswith(name + "-") \
+        # Exact-name match only: startswith would let "gen-ema-50" satisfy
+        # name="gen" and resume the wrong model's weights.
+        if latest and re.fullmatch(re.escape(name) + r"-\d+",
+                                   os.path.basename(latest)) \
                 and os.path.exists(latest + ".npz"):
             return latest
         # The state file points at another name's save: scan for this name's.
-        best = None
-        base = os.path.join(directory, name)
-        for path in glob.glob(glob.escape(base) + "-*.npz"):
-            m = re.fullmatch(re.escape(base) + r"-(\d+)\.npz", path)
-            if m and (best is None or int(m.group(1)) > best[0]):
-                best = (int(m.group(1)), path[:-len(".npz")])
-        return best[1] if best else None
+        found = _scan_checkpoints(os.path.join(directory, name))
+        return found[-1][1] if found else None
 
     def restore_params(self, prefix: str) -> Dict[str, Any]:
         """Load the parameter tree as a nested host-numpy dict (original names)."""

@@ -57,7 +57,7 @@ class Bert(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, token_types):
+    def __call__(self, tokens, token_types, mlm_positions=None):
         cfg = self.config
         _, length = tokens.shape
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -75,7 +75,14 @@ class Bert(nn.Module):
         for i in range(cfg.n_layers):
             x = EncoderBlock(cfg, name=f"layer_{i}")(x, pad_mask)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
-        return emb.attend(x.astype(jnp.float32))  # tied MLM logits
+        if mlm_positions is not None:
+            # Gather the prediction slots BEFORE the vocab projection (the
+            # reference's gather_indexes): the tied head then runs on [B, P, d]
+            # instead of [B, L, d] — at P=20, L=128 that is 6.4x fewer head
+            # FLOPs and a [B, P, V] logits tensor instead of [B, L, V].
+            x = jnp.take_along_axis(x, mlm_positions[..., None], axis=1)
+        # Head matmul in compute dtype; the loss upcasts for the softmax.
+        return emb.attend(x)  # tied MLM logits
 
 
 def make_mlm_loss_fn(model: Bert) -> Callable:
@@ -84,10 +91,10 @@ def make_mlm_loss_fn(model: Bert) -> Callable:
     fixed max_predictions_per_seq)."""
 
     def loss_fn(params, batch):
-        logits = model.apply({"params": params}, batch["tokens"], batch["token_types"])
-        pos = batch["mlm_positions"]                      # [B, P]
-        logits_at = jnp.take_along_axis(logits, pos[..., None], axis=1)   # [B, P, V]
-        logprobs = jax.nn.log_softmax(logits_at, axis=-1)
+        logits_at = model.apply({"params": params}, batch["tokens"],
+                                batch["token_types"],
+                                mlm_positions=batch["mlm_positions"])  # [B, P, V]
+        logprobs = jax.nn.log_softmax(logits_at.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logprobs, batch["mlm_targets"][..., None],
                                    axis=-1)[..., 0]
         w = batch["mlm_weights"].astype(nll.dtype)

@@ -38,10 +38,11 @@ def main():
         vocab_size=32_000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
         max_len=512, dtype=jnp.bfloat16 if on_accel else jnp.float32,
         tied_output=False)
-    # Swept on a v5e chip: 96/device = ~375k tokens/s vs 341k at 64 and 365k at
-    # 128; longer sequences lose (315k at seq512); 256/device OOMs.
+    # Swept on a v5e chip (bf16 lm_head halves the logits tensor, so larger
+    # batches fit than the first-round sweep found): 256/device = ~404k tokens/s
+    # vs 389k at 128 and 381k at 96; 384/device OOMs; seq512 loses (346k at 128).
     seq_len = 256 if on_accel else 64
-    batch_size = (96 if on_accel else 8) * n_dev
+    batch_size = (256 if on_accel else 8) * n_dev
 
     model, params = transformer_lm.init_params(cfg)
     loss_fn = transformer_lm.make_loss_fn(model)

@@ -120,6 +120,20 @@ def test_two_names_share_directory_without_cross_talk(tmp_path):
     assert len(glob.glob(f"{ckpt}/disc-*.npz")) == 2
 
 
+def test_dash_prefix_names_do_not_collide(tmp_path):
+    """name="gen" must never resume from "gen-ema" checkpoints."""
+    ckpt = str(tmp_path / "ckpts")
+    train(_runner(), _params(), _batch_fn, steps=3, checkpoint_dir=ckpt,
+          checkpoint_name="gen", log_every=0)
+    train(_runner(), _params(), _batch_fn, steps=7, checkpoint_dir=ckpt,
+          checkpoint_name="gen-ema", log_every=0)  # saves last -> owns state file
+    assert Saver.latest_checkpoint(ckpt, name="gen").endswith("/gen-3")
+    assert Saver.latest_checkpoint(ckpt, name="gen-ema").endswith("/gen-ema-7")
+    resumed = train(_runner(), _params(), _batch_fn, steps=5, checkpoint_dir=ckpt,
+                    checkpoint_name="gen", log_every=0)
+    assert int(resumed.step) == 5  # resumed gen-3, not gen-ema-7
+
+
 def test_metrics_callback_fires():
     seen = []
     train(_runner(), _params(), _batch_fn, steps=7, log_every=3,
