@@ -91,23 +91,28 @@ def make_loss_fn(model: LSTMLMWithHead) -> Callable:
     def loss_fn(params, batch):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        h = model.apply({"params": params}, inputs).astype(jnp.float32)
+        # Sampled-softmax logit matmuls run in the model's compute dtype (the
+        # [B,T,S,H] negatives einsum is the hot op; f32 would run it at a
+        # fraction of the MXU rate); the softmax/logsumexp below is f32.
+        h = model.apply({"params": params}, inputs)
         w = params["softmax_w"]            # [V, H]
         b = params["softmax_b"]            # [V]
 
         if "neg_ids" not in batch:
-            logits = h @ w.T + b
+            logits = (h @ w.T.astype(h.dtype)).astype(jnp.float32) + b
             logprobs = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
             return nll.mean()
 
         neg_ids = batch["neg_ids"]         # [S], static length
         # True-class logit: gather one row per target (row-sparse grad on w).
-        w_true = w[targets]                                   # [B, T, H]
-        true_logit = jnp.einsum("bth,bth->bt", h, w_true) + b[targets]
+        w_true = w[targets].astype(h.dtype)                   # [B, T, H]
+        true_logit = jnp.einsum("bth,bth->bt", h, w_true).astype(jnp.float32) \
+            + b[targets]
         # Sampled negatives: one shared [S, H] gather for the whole batch.
-        w_neg = w[neg_ids]                                    # [S, H]
-        neg_logits = jnp.einsum("bth,sh->bts", h, w_neg) + b[neg_ids]
+        w_neg = w[neg_ids].astype(h.dtype)                    # [S, H]
+        neg_logits = jnp.einsum("bth,sh->bts", h, w_neg).astype(jnp.float32) \
+            + b[neg_ids]
         if model.config.subtract_log_q:
             # Importance correction: logits -= log q(id) under the log-uniform
             # sampler q(id) = (log(id+2) - log(id+1)) / log(V+1). Applied to the
