@@ -99,7 +99,10 @@ def make_loss_fn(model: LSTMLMWithHead) -> Callable:
         b = params["softmax_b"]            # [V]
 
         if "neg_ids" not in batch:
-            logits = (h @ w.T.astype(h.dtype)).astype(jnp.float32) + b
+            # bf16 MXU inputs, f32 accumulate/output (preferred_element_type):
+            # same rate, no bf16 rounding of the reduced logit.
+            logits = jnp.matmul(h, w.T.astype(h.dtype),
+                                preferred_element_type=jnp.float32) + b
             logprobs = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
             return nll.mean()
@@ -107,12 +110,12 @@ def make_loss_fn(model: LSTMLMWithHead) -> Callable:
         neg_ids = batch["neg_ids"]         # [S], static length
         # True-class logit: gather one row per target (row-sparse grad on w).
         w_true = w[targets].astype(h.dtype)                   # [B, T, H]
-        true_logit = jnp.einsum("bth,bth->bt", h, w_true).astype(jnp.float32) \
-            + b[targets]
+        true_logit = jnp.einsum("bth,bth->bt", h, w_true,
+                                preferred_element_type=jnp.float32) + b[targets]
         # Sampled negatives: one shared [S, H] gather for the whole batch.
         w_neg = w[neg_ids].astype(h.dtype)                    # [S, H]
-        neg_logits = jnp.einsum("bth,sh->bts", h, w_neg).astype(jnp.float32) \
-            + b[neg_ids]
+        neg_logits = jnp.einsum("bth,sh->bts", h, w_neg,
+                                preferred_element_type=jnp.float32) + b[neg_ids]
         if model.config.subtract_log_q:
             # Importance correction: logits -= log q(id) under the log-uniform
             # sampler q(id) = (log(id+2) - log(id+1)) / log(V+1). Applied to the

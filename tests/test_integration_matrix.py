@@ -18,9 +18,9 @@ import optax
 import pytest
 
 from autodist_tpu import AutoDist
-from autodist_tpu.strategy import (AllReduce, Parallax, PartitionedAR, PartitionedPS,
-                                   PS, PSLoadBalancing, RandomAxisPartitionAR,
-                                   UnevenPartitionedPS)
+from autodist_tpu.strategy import (AllReduce, AutoStrategy, Parallax, PartitionedAR,
+                                   PartitionedPS, PS, PSLoadBalancing,
+                                   RandomAxisPartitionAR, UnevenPartitionedPS)
 
 BATCH = 16
 
@@ -145,7 +145,7 @@ CASES = {
 
 STRATEGIES = [
     PS, PSLoadBalancing, PartitionedPS, UnevenPartitionedPS,
-    AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax,
+    AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax, AutoStrategy,
 ]
 
 
@@ -161,3 +161,18 @@ def test_strategy_times_case(builder_cls, case_name):
     final = step.get_state().params
     assert all(np.all(np.isfinite(np.asarray(v)))
                for v in jax.tree_util.tree_leaves(final))
+
+
+@pytest.mark.parametrize("case_name", list(CASES), ids=str)
+@pytest.mark.parametrize("builder_cls", [AllReduce, PartitionedPS, Parallax],
+                         ids=lambda c: c.__name__)
+def test_strategy_times_case_with_accumulation(builder_cls, case_name):
+    """The micro-batch scan must compose with every case shape (BATCH=16 splits
+    into 2 micro-batches over the 8-device mesh)."""
+    params, batch, loss = CASES[case_name]()
+    ad = AutoDist(strategy_builder=builder_cls())
+    step = ad.function(loss, params, optax.adam(3e-2), example_batch=batch,
+                       accumulation_steps=2)
+    losses = [float(step(batch)) for _ in range(8)]
+    assert np.all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], (builder_cls.__name__, case_name, losses)

@@ -173,6 +173,27 @@ def test_lstm_lm_sampled_softmax_approximates_full_softmax():
     np.testing.assert_allclose(sampled, full, rtol=1e-5)
 
 
+def test_lstm_lm_bf16_sampled_softmax_trains_and_tracks_f32():
+    """The accelerator dtype path: finite bf16 training, losses near the f32
+    run within bf16 tolerance (the suite otherwise pins f32, which would make
+    the bf16 casts dead code under test)."""
+    from autodist_tpu.models import lstm_lm
+
+    def run(dtype):
+        cfg = lstm_lm.LSTMLMConfig(vocab_size=256, emb_dim=16, hidden_dim=32,
+                                   n_layers=2, num_sampled=64, dtype=dtype)
+        model, params = lstm_lm.init_params(cfg)
+        loss_fn = lstm_lm.make_loss_fn(model)
+        batch = lstm_lm.synthetic_batch(cfg, batch_size=8, seq_len=12)
+        ad = AutoDist(strategy_builder=Parallax())
+        step = ad.function(loss_fn, params, optax.adam(1e-2), example_batch=batch)
+        return [float(step(batch)) for _ in range(4)]
+
+    f32, bf16 = run(jnp.float32), run(jnp.bfloat16)
+    assert np.isfinite(bf16).all() and bf16[-1] < bf16[0]
+    np.testing.assert_allclose(bf16, f32, rtol=0.05)
+
+
 def test_lstm_lm_log_q_correction_matches_manual():
     # subtract_log_q shifts each logit by -log q(id) under the log-uniform
     # sampler; verify against a hand-computed correction of the uncorrected loss.
