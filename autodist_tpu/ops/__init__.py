@@ -2,5 +2,7 @@
 
 from autodist_tpu.ops.blockwise_attention import blockwise_attention
 from autodist_tpu.ops.flash_attention import flash_attention
+from autodist_tpu.ops.fused_xent import fused_softmax_xent, matmul_logsumexp
 
-__all__ = ["blockwise_attention", "flash_attention"]
+__all__ = ["blockwise_attention", "flash_attention", "fused_softmax_xent",
+           "matmul_logsumexp"]

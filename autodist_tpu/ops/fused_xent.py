@@ -1,0 +1,268 @@
+"""Fused LM-head softmax cross-entropy — pallas TPU kernels.
+
+The separable-head formulation of the LM loss is
+
+    nll_n = lse_n - true_logit_n,   lse_n = logsumexp_v(h_n . w_v + b_v)
+
+where the [N, V] logits tensor (4.2 GB at the flagship's N=65k, V=32k, bf16) is
+pure intermediate: XLA materializes it out of the head matmul, reads it for the
+log-softmax reductions, and reads/writes it again for d(logits) in the backward
+— the single largest HBM consumer in the training step. These kernels compute
+``lse`` (and its VJP) **without ever materializing logits in HBM**: each
+[n-block, v-block] logits tile lives only in VMEM, reduced on the fly with the
+same online-logsumexp state machine as the flash-attention kernel
+(``ops/flash_attention.py``), and the backward recomputes tiles from the saved
+``lse`` exactly like flash attention recomputes scores (FlashAttention-2 style).
+The true-logit term is a cheap gather-einsum left to XLA.
+
+Three kernels:
+- forward: grid (n-blocks, v-blocks); VMEM scratch carries (m, l) across the v
+  dimension; last v-block writes ``lse = m + log l``.
+- d(h):    grid (n-blocks, v-blocks); accumulates g*p @ w^T tiles in VMEM.
+- d(w,b):  grid (v-blocks, n-blocks); accumulates h^T @ g*p and column-sums.
+
+When to use (measured on a v5e chip): at the flagship size (N=65k, V=32k) this
+is throughput-parity with XLA (73 vs 69 ms for loss+grads — the two backward
+logit recomputes cost what the avoided HBM traffic saves), so the dense-head
+models keep the XLA path. The win is **memory**: nothing here scales with N*V,
+so configurations whose logits cannot exist run fine — measured: V=262k
+(32 GiB of logits) and N=262k (16 GiB) both train where XLA OOMs, and
+full-softmax cross-entropy over lm1b's exact 793,471-word vocabulary (48 GiB
+of logits; the reference needed sampled softmax to avoid it) runs at ~41k
+tokens/s/chip with exact gradients.
+
+On non-TPU backends the kernels run in pallas interpret mode, so the CPU-sim
+test mesh exercises the same code path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from autodist_tpu.ops.blockwise_attention import NEG_INF
+from autodist_tpu.ops.flash_attention import _use_interpret
+
+_LANES = 128
+DEFAULT_N_BLOCK = 512
+DEFAULT_V_BLOCK = 1024
+
+
+# ------------------------------------------------------------------- forward
+
+def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int):
+    ni = pl.program_id(0)
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    logits = jax.lax.dot_general(
+        h_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[0][None, :]   # [bn, bv]
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
+    p = jnp.exp(logits - m_new)
+    l_ref[:] = jnp.broadcast_to(
+        l_prev * jnp.exp(m_prev - m_new) + p.sum(axis=-1, keepdims=True),
+        l_ref.shape)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(vi == n_v - 1)
+    def _finish():
+        lse_ref[0, ni, :] = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+
+
+def _pad_inputs(h, w, b, bn, bv):
+    n, d = h.shape
+    v = w.shape[1]
+    n_n, n_v = pl.cdiv(n, bn), pl.cdiv(v, bv)
+    if n_n * bn - n:
+        h = jnp.pad(h, ((0, n_n * bn - n), (0, 0)))
+    if n_v * bv - v:
+        w = jnp.pad(w, ((0, 0), (0, n_v * bv - v)))
+        # Padded vocab columns get a -inf bias: exp -> 0, invisible to the lse.
+        b = jnp.pad(b, (0, n_v * bv - v), constant_values=NEG_INF)
+    return h, w, b.reshape(1, -1), n_n, n_v
+
+
+def _forward(h, w, b, bn, bv, interpret):
+    n, d = h.shape
+    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv)
+    lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_v=n_v),
+        grid=(n_n, n_v),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bv), lambda i, j: (0, j)),
+        ],
+        # Whole [n_n, bn] plane resident (a [1, bn] block violates TPU tiling);
+        # 4 bytes/row — same layout rationale as the flash kernel's lse.
+        out_specs=pl.BlockSpec((1, n_n, bn), lambda i, j: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, n_n, bn), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bn, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((bn, _LANES), jnp.float32),   # running denominator
+        ],
+        interpret=interpret,
+    )(hp, wp, bp)
+    return lse.reshape(n_n * bn)[:n]
+
+
+# ------------------------------------------------------------------ backward
+
+def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int):
+    ni = pl.program_id(0)
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    logits = jax.lax.dot_general(
+        h_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[0][None, :]
+    lse = lse_ref[0, ni, :]                                   # [bn]
+    gp = jnp.exp(logits - lse[:, None]) * g_ref[0, ni, :][:, None]  # [bn, bv]
+    acc_ref[:] += jax.lax.dot_general(
+        gp.astype(w_ref.dtype), w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [bn, d]
+
+    @pl.when(vi == n_v - 1)
+    def _finish():
+        dh_ref[...] = acc_ref[:].astype(dh_ref.dtype)
+
+
+def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
+                 dw_acc, db_acc, *, n_n: int):
+    ni = pl.program_id(1)  # read at top level: program_id is invalid inside when-bodies in interpret mode
+
+    @pl.when(ni == 0)
+    def _init():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    logits = jax.lax.dot_general(
+        h_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[0][None, :]   # [bn, bv]
+    lse = lse_ref[0, ni, :]
+    gp = jnp.exp(logits - lse[:, None]) * g_ref[0, ni, :][:, None]
+    dw_acc[:] += jax.lax.dot_general(
+        h_ref[...], gp.astype(h_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [d, bv]
+    db_acc[:, :] += jnp.broadcast_to(gp.sum(axis=0)[None, :], db_acc.shape)
+
+    @pl.when(ni == n_n - 1)
+    def _finish():
+        dw_ref[...] = dw_acc[:].astype(dw_ref.dtype)
+        db_ref[...] = db_acc[:1, :].astype(db_ref.dtype)
+
+
+def _backward(h, w, b, lse, g, bn, bv, interpret):
+    n, d = h.shape
+    v = w.shape[1]
+    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv)
+    lse_p = jnp.pad(lse, (0, n_n * bn - n)).reshape(1, n_n, bn)
+    # Padding rows must contribute nothing: their incoming gradient pads as zero.
+    g_p = jnp.pad(g.astype(jnp.float32), (0, n_n * bn - n)).reshape(1, n_n, bn)
+
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, n_v=n_v),
+        grid=(n_n, n_v),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((1, n_n, bn), lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((1, n_n, bn), lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_n * bn, d), h.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=interpret,
+    )(hp, wp, bp, lse_p, g_p)[:n]
+
+    dw, db = pl.pallas_call(
+        functools.partial(_dwdb_kernel, n_n=n_n),
+        grid=(n_v, n_n),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda j, i: (i, 0)),
+            pl.BlockSpec((d, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((1, n_n, bn), lambda j, i: (0, 0, 0)),
+            pl.BlockSpec((1, n_n, bn), lambda j, i: (0, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((d, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((d, n_v * bv), w.dtype),
+            jax.ShapeDtypeStruct((1, n_v * bv), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((d, bv), jnp.float32),
+            pltpu.VMEM((_LANES, bv), jnp.float32),
+        ],
+        interpret=interpret,
+    )(hp, wp, bp, lse_p, g_p)
+    return dh, dw[:, :v], db[0, :v]
+
+
+# ----------------------------------------------------------------- public op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def matmul_logsumexp(h, w, b, n_block: int = DEFAULT_N_BLOCK,
+                     v_block: int = DEFAULT_V_BLOCK,
+                     interpret: bool = None):
+    """``logsumexp(h @ w + b, axis=-1)`` without materializing the logits.
+
+    h: [N, D] (bf16/f32), w: [D, V], b: [V] (or None for no bias).
+    Returns f32 [N]. Differentiable in h, w, b (custom VJP recomputes logits
+    tiles from the saved lse).
+    """
+    lse, _ = _mls_fwd(h, w, b, n_block, v_block, interpret)
+    return lse
+
+
+def _mls_fwd(h, w, b, n_block, v_block, interpret):
+    if interpret is None:
+        interpret = _use_interpret()
+    has_bias = b is not None
+    bvec = b if has_bias else jnp.zeros((w.shape[1],), jnp.float32)
+    lse = _forward(h, w, bvec, n_block, v_block, interpret)
+    return lse, (h, w, bvec, lse, has_bias)
+
+
+def _mls_bwd(n_block, v_block, interpret, res, g):
+    if interpret is None:
+        interpret = _use_interpret()
+    h, w, bvec, lse, has_bias = res
+    dh, dw, db = _backward(h, w, bvec, lse, g, n_block, v_block, interpret)
+    return dh, dw, (db if has_bias else None)
+
+
+matmul_logsumexp.defvjp(_mls_fwd, _mls_bwd)
+
+
+def fused_softmax_xent(h, w, targets, b=None, n_block: int = DEFAULT_N_BLOCK,
+                       v_block: int = DEFAULT_V_BLOCK) -> jax.Array:
+    """Per-row NLL of ``targets`` under ``softmax(h @ w + b)`` — the fused-head
+    loss. h: [N, D], w: [D, V], targets: int [N]. Returns f32 [N].
+
+    The lse term runs through the pallas kernels; the true-logit term is a
+    gather-einsum XLA handles well (its grad is the row-sparse scatter).
+    """
+    lse = matmul_logsumexp(h, w, b, n_block, v_block, None)
+    w_true = jnp.take(w, targets, axis=1)                  # [D, N]
+    true_logit = jnp.einsum("nd,dn->n", h, w_true,
+                            preferred_element_type=jnp.float32)
+    if b is not None:
+        true_logit = true_logit + b[targets]
+    return lse - true_logit
