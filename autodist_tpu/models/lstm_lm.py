@@ -140,6 +140,33 @@ def make_loss_fn(model: LSTMLMWithHead) -> Callable:
     return loss_fn
 
 
+def make_fused_full_softmax_loss_fn(model: LSTMLMWithHead) -> Callable:
+    """EXACT full-vocabulary softmax NLL via the pallas fused kernels.
+
+    The reference could not train lm1b with the true softmax — at 793k words
+    the logits tensor is tens of GiB, hence its sampled softmax
+    (``language_model.py:15-30``). ``ops.fused_softmax_xent`` never
+    materializes logits, so this loss trains the same model with the exact
+    objective instead of the sampled approximation. Batch needs only
+    ``tokens`` (no ``neg_ids``)."""
+
+    def loss_fn(params, batch):
+        from autodist_tpu.ops.fused_xent import fused_softmax_xent
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        h = model.apply({"params": params}, inputs)
+        n = h.shape[0] * h.shape[1]
+        h2 = h.reshape(n, h.shape[-1])
+        # softmax_w stays in its stored [V, H] layout and f32 dtype — the kernel
+        # contracts it as-is and casts per tile in VMEM, so no transposed or
+        # downcast copy of the multi-GiB table is ever materialized.
+        nll = fused_softmax_xent(h2, params["softmax_w"], targets.reshape(n),
+                                 params["softmax_b"], w_layout="vd")
+        return nll.mean()
+
+    return loss_fn
+
+
 def init_params(config: LSTMLMConfig, rng: Optional[jax.Array] = None,
                 batch_size: int = 2):
     rng = rng if rng is not None else jax.random.PRNGKey(0)

@@ -15,6 +15,12 @@ same online-logsumexp state machine as the flash-attention kernel
 ``lse`` exactly like flash attention recomputes scores (FlashAttention-2 style).
 The true-logit term is a cheap gather-einsum left to XLA.
 
+``w`` is accepted in either layout — ``[D, V]`` (flax Dense kernel) or
+``[V, H]`` (the reference's softmax_w; ``w_layout="vd"``) — and is cast to the
+activation dtype **per tile inside the kernel**, so no transposed or downcast
+copy of a multi-GiB table is ever materialized, and its gradient comes back in
+the stored layout/dtype directly.
+
 Three kernels:
 - forward: grid (n-blocks, v-blocks); VMEM scratch carries (m, l) across the v
   dimension; last v-block writes ``lse = m + log l``.
@@ -26,10 +32,10 @@ is throughput-parity with XLA (73 vs 69 ms for loss+grads — the two backward
 logit recomputes cost what the avoided HBM traffic saves), so the dense-head
 models keep the XLA path. The win is **memory**: nothing here scales with N*V,
 so configurations whose logits cannot exist run fine — measured: V=262k
-(32 GiB of logits) and N=262k (16 GiB) both train where XLA OOMs, and
-full-softmax cross-entropy over lm1b's exact 793,471-word vocabulary (48 GiB
-of logits; the reference needed sampled softmax to avoid it) runs at ~41k
-tokens/s/chip with exact gradients.
+(32 GiB of logits) and N=262k (16 GiB) both train where XLA OOMs, and the
+lm1b example trains its exact 793,471-word vocabulary with the TRUE softmax
+objective (48 GiB of logits if materialized; the reference needed sampled
+softmax) at ~38k words/s/chip end to end.
 
 On non-TPU backends the kernels run in pallas interpret mode, so the CPU-sim
 test mesh exercises the same code path.
@@ -48,11 +54,27 @@ from autodist_tpu.ops.flash_attention import _use_interpret
 _LANES = 128
 DEFAULT_N_BLOCK = 512
 DEFAULT_V_BLOCK = 1024
+# Padding rows' lse: large POSITIVE so exp(logits - lse) underflows to exactly 0
+# whatever the bias — padding with 0 would overflow exp for bias values > ~88
+# and poison dw/db with NaN through inf * 0.
+_PAD_LSE = 1e30
+
+
+def _logits_tile(h_ref, w_ref, b_ref, w_vd: bool):
+    """([bn, bv] f32 logits tile, cast w tile). The single place the per-tile
+    activation-dtype cast happens — w is contracted per its stored layout with
+    no HBM copy of the table."""
+    wt = w_ref[...].astype(h_ref.dtype)
+    dims = (((1,), (1,)), ((), ())) if w_vd else (((1,), (0,)), ((), ()))
+    logits = jax.lax.dot_general(h_ref[...], wt, dims,
+                                 preferred_element_type=jnp.float32)
+    return logits + b_ref[0][None, :], wt
 
 
 # ------------------------------------------------------------------- forward
 
-def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int):
+def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int,
+                w_vd: bool):
     ni = pl.program_id(0)
     vi = pl.program_id(1)
 
@@ -61,9 +83,7 @@ def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int):
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    logits = jax.lax.dot_general(
-        h_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) + b_ref[0][None, :]   # [bn, bv]
+    logits, _ = _logits_tile(h_ref, w_ref, b_ref, w_vd)       # [bn, bv]
     m_prev = m_ref[:, :1]
     l_prev = l_ref[:, :1]
     m_new = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
@@ -78,28 +98,37 @@ def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int):
         lse_ref[0, ni, :] = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
 
 
-def _pad_inputs(h, w, b, bn, bv):
+def _pad_inputs(h, w, b, bn, bv, w_vd: bool):
     n, d = h.shape
-    v = w.shape[1]
+    v = w.shape[0] if w_vd else w.shape[1]
     n_n, n_v = pl.cdiv(n, bn), pl.cdiv(v, bv)
     if n_n * bn - n:
         h = jnp.pad(h, ((0, n_n * bn - n), (0, 0)))
     if n_v * bv - v:
-        w = jnp.pad(w, ((0, 0), (0, n_v * bv - v)))
+        pad_v = ((0, n_v * bv - v), (0, 0)) if w_vd else ((0, 0), (0, n_v * bv - v))
+        w = jnp.pad(w, pad_v)
         # Padded vocab columns get a -inf bias: exp -> 0, invisible to the lse.
         b = jnp.pad(b, (0, n_v * bv - v), constant_values=NEG_INF)
     return h, w, b.reshape(1, -1), n_n, n_v
 
 
-def _forward(h, w, b, bn, bv, interpret):
+def _w_spec(d, bv, w_vd, index2):
+    """BlockSpec for one vocab tile of w in its stored layout. ``index2`` maps
+    grid coords to the vocab-block index."""
+    if w_vd:
+        return pl.BlockSpec((bv, d), lambda *a: (index2(*a), 0))
+    return pl.BlockSpec((d, bv), lambda *a: (0, index2(*a)))
+
+
+def _forward(h, w, b, bn, bv, interpret, w_vd):
     n, d = h.shape
-    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv)
+    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv, w_vd)
     lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, n_v=n_v),
+        functools.partial(_fwd_kernel, n_v=n_v, w_vd=w_vd),
         grid=(n_n, n_v),
         in_specs=[
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            _w_spec(d, bv, w_vd, lambda i, j: j),
             pl.BlockSpec((1, bv), lambda i, j: (0, j)),
         ],
         # Whole [n_n, bn] plane resident (a [1, bn] block violates TPU tiling);
@@ -117,7 +146,8 @@ def _forward(h, w, b, bn, bv, interpret):
 
 # ------------------------------------------------------------------ backward
 
-def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int):
+def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int,
+               w_vd: bool):
     ni = pl.program_id(0)
     vi = pl.program_id(1)
 
@@ -125,13 +155,12 @@ def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    logits = jax.lax.dot_general(
-        h_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) + b_ref[0][None, :]
+    logits, wt = _logits_tile(h_ref, w_ref, b_ref, w_vd)
     lse = lse_ref[0, ni, :]                                   # [bn]
     gp = jnp.exp(logits - lse[:, None]) * g_ref[0, ni, :][:, None]  # [bn, bv]
+    dims = (((1,), (0,)), ((), ())) if w_vd else (((1,), (1,)), ((), ()))
     acc_ref[:] += jax.lax.dot_general(
-        gp.astype(w_ref.dtype), w_ref[...], (((1,), (1,)), ((), ())),
+        gp.astype(wt.dtype), wt, dims,
         preferred_element_type=jnp.float32)                   # [bn, d]
 
     @pl.when(vi == n_v - 1)
@@ -140,7 +169,7 @@ def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int
 
 
 def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
-                 dw_acc, db_acc, *, n_n: int):
+                 dw_acc, db_acc, *, n_n: int, w_vd: bool):
     ni = pl.program_id(1)  # read at top level: program_id is invalid inside when-bodies in interpret mode
 
     @pl.when(ni == 0)
@@ -148,14 +177,18 @@ def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
         dw_acc[:] = jnp.zeros_like(dw_acc)
         db_acc[:] = jnp.zeros_like(db_acc)
 
-    logits = jax.lax.dot_general(
-        h_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) + b_ref[0][None, :]   # [bn, bv]
+    logits, _ = _logits_tile(h_ref, w_ref, b_ref, w_vd)       # [bn, bv]
     lse = lse_ref[0, ni, :]
     gp = jnp.exp(logits - lse[:, None]) * g_ref[0, ni, :][:, None]
-    dw_acc[:] += jax.lax.dot_general(
-        h_ref[...], gp.astype(h_ref.dtype), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                   # [d, bv]
+    gph = gp.astype(h_ref.dtype)
+    if w_vd:
+        dw_acc[:] += jax.lax.dot_general(                     # [bv, d]
+            gph, h_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        dw_acc[:] += jax.lax.dot_general(                     # [d, bv]
+            h_ref[...], gph, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
     db_acc[:, :] += jnp.broadcast_to(gp.sum(axis=0)[None, :], db_acc.shape)
 
     @pl.when(ni == n_n - 1)
@@ -164,20 +197,22 @@ def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
         db_ref[...] = db_acc[:1, :].astype(db_ref.dtype)
 
 
-def _backward(h, w, b, lse, g, bn, bv, interpret):
+def _backward(h, w, b, lse, g, bn, bv, interpret, w_vd):
     n, d = h.shape
-    v = w.shape[1]
-    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv)
-    lse_p = jnp.pad(lse, (0, n_n * bn - n)).reshape(1, n_n, bn)
-    # Padding rows must contribute nothing: their incoming gradient pads as zero.
+    v = w.shape[0] if w_vd else w.shape[1]
+    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv, w_vd)
+    lse_p = jnp.pad(lse, (0, n_n * bn - n),
+                    constant_values=_PAD_LSE).reshape(1, n_n, bn)
+    # Padding rows must contribute nothing: their incoming gradient pads as zero
+    # AND their lse pads large-positive so exp underflows (see _PAD_LSE).
     g_p = jnp.pad(g.astype(jnp.float32), (0, n_n * bn - n)).reshape(1, n_n, bn)
 
     dh = pl.pallas_call(
-        functools.partial(_dh_kernel, n_v=n_v),
+        functools.partial(_dh_kernel, n_v=n_v, w_vd=w_vd),
         grid=(n_n, n_v),
         in_specs=[
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            _w_spec(d, bv, w_vd, lambda i, j: j),
             pl.BlockSpec((1, bv), lambda i, j: (0, j)),
             pl.BlockSpec((1, n_n, bn), lambda i, j: (0, 0, 0)),
             pl.BlockSpec((1, n_n, bn), lambda i, j: (0, 0, 0)),
@@ -188,63 +223,76 @@ def _backward(h, w, b, lse, g, bn, bv, interpret):
         interpret=interpret,
     )(hp, wp, bp, lse_p, g_p)[:n]
 
+    dw_shape = (n_v * bv, d) if w_vd else (d, n_v * bv)
+    dw_scratch = pltpu.VMEM((bv, d) if w_vd else (d, bv), jnp.float32)
     dw, db = pl.pallas_call(
-        functools.partial(_dwdb_kernel, n_n=n_n),
+        functools.partial(_dwdb_kernel, n_n=n_n, w_vd=w_vd),
         grid=(n_v, n_n),
         in_specs=[
             pl.BlockSpec((bn, d), lambda j, i: (i, 0)),
-            pl.BlockSpec((d, bv), lambda j, i: (0, j)),
+            _w_spec(d, bv, w_vd, lambda j, i: j),
             pl.BlockSpec((1, bv), lambda j, i: (0, j)),
             pl.BlockSpec((1, n_n, bn), lambda j, i: (0, 0, 0)),
             pl.BlockSpec((1, n_n, bn), lambda j, i: (0, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((d, bv), lambda j, i: (0, j)),
+            _w_spec(d, bv, w_vd, lambda j, i: j),
             pl.BlockSpec((1, bv), lambda j, i: (0, j)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((d, n_v * bv), w.dtype),
+            jax.ShapeDtypeStruct(dw_shape, w.dtype),
             jax.ShapeDtypeStruct((1, n_v * bv), jnp.float32),
         ),
         scratch_shapes=[
-            pltpu.VMEM((d, bv), jnp.float32),
+            dw_scratch,
             pltpu.VMEM((_LANES, bv), jnp.float32),
         ],
         interpret=interpret,
     )(hp, wp, bp, lse_p, g_p)
-    return dh, dw[:, :v], db[0, :v]
+    dw = dw[:v, :] if w_vd else dw[:, :v]
+    return dh, dw, db[0, :v]
 
 
 # ----------------------------------------------------------------- public op
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def matmul_logsumexp(h, w, b, n_block: int = DEFAULT_N_BLOCK,
                      v_block: int = DEFAULT_V_BLOCK,
-                     interpret: bool = None):
+                     interpret: bool = None, w_layout: str = "dv"):
     """``logsumexp(h @ w + b, axis=-1)`` without materializing the logits.
 
-    h: [N, D] (bf16/f32), w: [D, V], b: [V] (or None for no bias).
+    h: [N, D] (bf16/f32); w: [D, V] (``w_layout="dv"``, flax Dense kernel) or
+    [V, D] (``w_layout="vd"``, reference softmax_w layout); b: [V] or None.
     Returns f32 [N]. Differentiable in h, w, b (custom VJP recomputes logits
-    tiles from the saved lse).
+    tiles from the saved lse); dw returns in w's stored layout and dtype.
     """
-    lse, _ = _mls_fwd(h, w, b, n_block, v_block, interpret)
+    lse, _ = _mls_fwd(h, w, b, n_block, v_block, interpret, w_layout)
     return lse
 
 
-def _mls_fwd(h, w, b, n_block, v_block, interpret):
+def _w_vd(w_layout: str) -> bool:
+    if w_layout not in ("dv", "vd"):
+        raise ValueError(f"w_layout must be 'dv' or 'vd', got {w_layout!r}")
+    return w_layout == "vd"
+
+
+def _mls_fwd(h, w, b, n_block, v_block, interpret, w_layout):
     if interpret is None:
         interpret = _use_interpret()
+    w_vd = _w_vd(w_layout)
     has_bias = b is not None
-    bvec = b if has_bias else jnp.zeros((w.shape[1],), jnp.float32)
-    lse = _forward(h, w, bvec, n_block, v_block, interpret)
+    v = w.shape[0] if w_vd else w.shape[1]
+    bvec = b if has_bias else jnp.zeros((v,), jnp.float32)
+    lse = _forward(h, w, bvec, n_block, v_block, interpret, w_vd)
     return lse, (h, w, bvec, lse, has_bias)
 
 
-def _mls_bwd(n_block, v_block, interpret, res, g):
+def _mls_bwd(n_block, v_block, interpret, w_layout, res, g):
     if interpret is None:
         interpret = _use_interpret()
     h, w, bvec, lse, has_bias = res
-    dh, dw, db = _backward(h, w, bvec, lse, g, n_block, v_block, interpret)
+    dh, dw, db = _backward(h, w, bvec, lse, g, n_block, v_block, interpret,
+                           _w_vd(w_layout))
     return dh, dw, (db if has_bias else None)
 
 
@@ -252,17 +300,23 @@ matmul_logsumexp.defvjp(_mls_fwd, _mls_bwd)
 
 
 def fused_softmax_xent(h, w, targets, b=None, n_block: int = DEFAULT_N_BLOCK,
-                       v_block: int = DEFAULT_V_BLOCK) -> jax.Array:
+                       v_block: int = DEFAULT_V_BLOCK,
+                       w_layout: str = "dv") -> jax.Array:
     """Per-row NLL of ``targets`` under ``softmax(h @ w + b)`` — the fused-head
-    loss. h: [N, D], w: [D, V], targets: int [N]. Returns f32 [N].
+    loss. h: [N, D], w per ``w_layout``, targets: int [N]. Returns f32 [N].
 
     The lse term runs through the pallas kernels; the true-logit term is a
     gather-einsum XLA handles well (its grad is the row-sparse scatter).
     """
-    lse = matmul_logsumexp(h, w, b, n_block, v_block, None)
-    w_true = jnp.take(w, targets, axis=1)                  # [D, N]
-    true_logit = jnp.einsum("nd,dn->n", h, w_true,
-                            preferred_element_type=jnp.float32)
+    lse = matmul_logsumexp(h, w, b, n_block, v_block, None, w_layout)
+    if _w_vd(w_layout):
+        w_true = jnp.take(w, targets, axis=0).astype(h.dtype)   # [N, D]
+        true_logit = jnp.einsum("nd,nd->n", h, w_true,
+                                preferred_element_type=jnp.float32)
+    else:
+        w_true = jnp.take(w, targets, axis=1).astype(h.dtype)   # [D, N]
+        true_logit = jnp.einsum("nd,dn->n", h, w_true,
+                                preferred_element_type=jnp.float32)
     if b is not None:
         true_logit = true_logit + b[targets]
     return lse - true_logit

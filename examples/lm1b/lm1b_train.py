@@ -35,8 +35,15 @@ def main(argv=None):
     parser.add_argument("--d_model", type=int, default=512)
     parser.add_argument("--n_layers", type=int, default=6)
     parser.add_argument("--vocab", type=int, default=32000)
+    parser.add_argument("--full_softmax", action="store_true",
+                        help="LSTM only: train with the EXACT full-vocab softmax "
+                             "(pallas fused kernels; logits never materialized) "
+                             "instead of the reference's sampled approximation — "
+                             "works even at --vocab 793471 (lm1b's real size)")
     parser.add_argument("--resource_spec", type=str, default=None)
     args = parser.parse_args(argv)
+    if args.full_softmax and args.model != "lstm":
+        parser.error("--full_softmax applies to --model lstm")
 
     import jax
     on_accel = jax.default_backend() != "cpu"
@@ -47,8 +54,13 @@ def main(argv=None):
             vocab_size=args.vocab, emb_dim=args.d_model,
             hidden_dim=2 * args.d_model, n_layers=args.n_layers, dtype=dtype)
         model, params = lstm_lm.init_params(cfg)
-        loss_fn = lstm_lm.make_loss_fn(model)
-        batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
+        if args.full_softmax:
+            loss_fn = lstm_lm.make_fused_full_softmax_loss_fn(model)
+            batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len,
+                                            sampled=False)
+        else:
+            loss_fn = lstm_lm.make_loss_fn(model)
+            batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
     else:
         cfg = transformer_lm.TransformerLMConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=8,

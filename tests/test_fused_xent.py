@@ -94,6 +94,54 @@ def test_fused_xent_matches_composed_loss():
         np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
 
 
+def test_vd_layout_matches_dv():
+    """[V, D]-stored tables (reference softmax_w layout) give identical values
+    and gradients without the caller transposing."""
+    h, w, b = _data(192, 64, 300, jnp.float32, seed=8)
+    w_vd = w.T  # stored [V, D]
+
+    def f_dv(h, w, b):
+        return jnp.sum(matmul_logsumexp(h, w, b, 64, 128) * 0.01)
+
+    def f_vd(h, w_vd, b):
+        return jnp.sum(matmul_logsumexp(h, w_vd, b, 64, 128, None, "vd") * 0.01)
+
+    np.testing.assert_allclose(f_vd(h, w_vd, b), f_dv(h, w, b), rtol=1e-6)
+    g_dv = jax.grad(f_dv, argnums=(0, 1, 2))(h, w, b)
+    g_vd = jax.grad(f_vd, argnums=(0, 1, 2))(h, w_vd, b)
+    np.testing.assert_allclose(g_vd[0], g_dv[0], rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(g_vd[1], g_dv[1].T, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(g_vd[2], g_dv[2], rtol=2e-4, atol=2e-5)
+    # Mixed dtype: f32 table with bf16 activations, cast per-tile in the kernel.
+    got = matmul_logsumexp(h.astype(jnp.bfloat16), w_vd, b, 64, 128, None, "vd")
+    np.testing.assert_allclose(got, _ref_lse(h, w, b), rtol=0.02, atol=0.02)
+
+
+def test_large_bias_with_padding_rows_stays_finite():
+    """Regression: pad rows' lse must pad large-positive, or a bias entry > ~88
+    overflows exp in the pad rows and NaNs the whole dw/db."""
+    h, w, b = _data(100, 64, 256, jnp.float32, seed=9)   # 28 pad rows at bn=128
+    b = b.at[5].set(95.0)
+    grads = jax.grad(lambda h, w, b: jnp.mean(matmul_logsumexp(h, w, b, 128, 128)),
+                     argnums=(0, 1, 2))(h, w, b)
+    for g_ in grads:
+        assert np.isfinite(np.asarray(g_)).all()
+    gr = jax.grad(lambda h, w, b: jnp.mean(_ref_lse(h, w, b)),
+                  argnums=(0, 1, 2))(h, w, b)
+    for a, e in zip(grads, gr):
+        np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_xent_vd_layout_matches():
+    n, d, v = 96, 64, 200
+    h, w, b = _data(n, d, v, jnp.float32, seed=10)
+    rng = np.random.RandomState(11)
+    targets = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
+    a = fused_softmax_xent(h, w, targets, b, 64, 128)
+    bb = fused_softmax_xent(h, w.T, targets, b, 64, 128, w_layout="vd")
+    np.testing.assert_allclose(bb, a, rtol=1e-5, atol=1e-5)
+
+
 def test_jit_and_value_under_jit():
     h, w, b = _data(128, 64, 256, jnp.float32, seed=7)
     f = jax.jit(lambda h, w, b: matmul_logsumexp(h, w, b, 64, 128))
