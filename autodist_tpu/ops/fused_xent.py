@@ -27,15 +27,18 @@ Three kernels:
 - d(h):    grid (n-blocks, v-blocks); accumulates g*p @ w^T tiles in VMEM.
 - d(w,b):  grid (v-blocks, n-blocks); accumulates h^T @ g*p and column-sums.
 
-When to use (measured on a v5e chip): at the flagship size (N=65k, V=32k) this
-is throughput-parity with XLA (73 vs 69 ms for loss+grads — the two backward
-logit recomputes cost what the avoided HBM traffic saves), so the dense-head
-models keep the XLA path. The win is **memory**: nothing here scales with N*V,
-so configurations whose logits cannot exist run fine — measured: V=262k
-(32 GiB of logits) and N=262k (16 GiB) both train where XLA OOMs, and the
-lm1b example trains its exact 793,471-word vocabulary with the TRUE softmax
-objective (48 GiB of logits if materialized; the reference needed sampled
-softmax) at ~38k words/s/chip end to end.
+Measured on a v5e chip: in the full flagship training step the fused head is
+faster than the XLA head at equal batch (410k vs 398k tokens/s at bs 256) and
+— because nothing here scales with N*V — unlocks batch sizes whose logits
+cannot exist: bs 384 (~428k tokens/s, the flagship bench config) OOMs with a
+materialized head. Larger still: V=262k (32 GiB of logits) and N=262k
+(16 GiB) both train where XLA OOMs, and the lm1b example trains its exact
+793,471-word vocabulary with the TRUE softmax objective (48 GiB of logits if
+materialized; the reference needed sampled softmax) at ~38k words/s/chip end
+to end. (An isolated loss+grads microbench is near-parity — 73 vs 69 ms —
+because the two backward logit recomputes cost roughly what the avoided HBM
+traffic saves; inside the full step, overlap with the rest of the model tips
+it to a win.)
 
 On non-TPU backends the kernels run in pallas interpret mode, so the CPU-sim
 test mesh exercises the same code path.
@@ -60,21 +63,30 @@ DEFAULT_V_BLOCK = 1024
 _PAD_LSE = 1e30
 
 
-def _logits_tile(h_ref, w_ref, b_ref, w_vd: bool):
-    """([bn, bv] f32 logits tile, cast w tile). The single place the per-tile
-    activation-dtype cast happens — w is contracted per its stored layout with
-    no HBM copy of the table."""
+def _logits_tile(h_ref, w_ref, b_ref, w_vd: bool, vi, bv: int, v: int):
+    """([bn, bv] f32 logits tile, cast+masked w tile). The single place the
+    per-tile activation-dtype cast happens — w is contracted per its stored
+    layout with no HBM copy of the table. The arrays are NOT padded to block
+    multiples (padding would copy the multi-GiB table every step): the ragged
+    last vocab tile reads undefined memory, which is zero-masked on the w side
+    (so no garbage inf/NaN can ride a contraction) and -inf-masked in the
+    logits (so the softmax never sees the lanes)."""
     wt = w_ref[...].astype(h_ref.dtype)
+    col = vi * bv + jax.lax.broadcasted_iota(jnp.int32, wt.shape,
+                                             0 if w_vd else 1)
+    wt = jnp.where(col < v, wt, jnp.zeros((), wt.dtype))
     dims = (((1,), (1,)), ((), ())) if w_vd else (((1,), (0,)), ((), ()))
     logits = jax.lax.dot_general(h_ref[...], wt, dims,
                                  preferred_element_type=jnp.float32)
-    return logits + b_ref[0][None, :], wt
+    logits = logits + b_ref[0][None, :]
+    lane = vi * bv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    return jnp.where(lane < v, logits, NEG_INF), wt
 
 
 # ------------------------------------------------------------------- forward
 
 def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int,
-                w_vd: bool):
+                w_vd: bool, bv: int, v: int):
     ni = pl.program_id(0)
     vi = pl.program_id(1)
 
@@ -83,7 +95,7 @@ def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    logits, _ = _logits_tile(h_ref, w_ref, b_ref, w_vd)       # [bn, bv]
+    logits, _ = _logits_tile(h_ref, w_ref, b_ref, w_vd, vi, bv, v)  # [bn, bv]
     m_prev = m_ref[:, :1]
     l_prev = l_ref[:, :1]
     m_new = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
@@ -98,18 +110,10 @@ def _fwd_kernel(h_ref, w_ref, b_ref, lse_ref, m_ref, l_ref, *, n_v: int,
         lse_ref[0, ni, :] = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
 
 
-def _pad_inputs(h, w, b, bn, bv, w_vd: bool):
+def _shapes(h, w, bn, bv, w_vd: bool):
     n, d = h.shape
     v = w.shape[0] if w_vd else w.shape[1]
-    n_n, n_v = pl.cdiv(n, bn), pl.cdiv(v, bv)
-    if n_n * bn - n:
-        h = jnp.pad(h, ((0, n_n * bn - n), (0, 0)))
-    if n_v * bv - v:
-        pad_v = ((0, n_v * bv - v), (0, 0)) if w_vd else ((0, 0), (0, n_v * bv - v))
-        w = jnp.pad(w, pad_v)
-        # Padded vocab columns get a -inf bias: exp -> 0, invisible to the lse.
-        b = jnp.pad(b, (0, n_v * bv - v), constant_values=NEG_INF)
-    return h, w, b.reshape(1, -1), n_n, n_v
+    return n, d, v, pl.cdiv(n, bn), pl.cdiv(v, bv)
 
 
 def _w_spec(d, bv, w_vd, index2):
@@ -121,10 +125,9 @@ def _w_spec(d, bv, w_vd, index2):
 
 
 def _forward(h, w, b, bn, bv, interpret, w_vd):
-    n, d = h.shape
-    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv, w_vd)
+    n, d, v, n_n, n_v = _shapes(h, w, bn, bv, w_vd)
     lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, n_v=n_v, w_vd=w_vd),
+        functools.partial(_fwd_kernel, n_v=n_v, w_vd=w_vd, bv=bv, v=v),
         grid=(n_n, n_v),
         in_specs=[
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
@@ -140,14 +143,14 @@ def _forward(h, w, b, bn, bv, interpret, w_vd):
             pltpu.VMEM((bn, _LANES), jnp.float32),   # running denominator
         ],
         interpret=interpret,
-    )(hp, wp, bp)
+    )(h, w, b.reshape(1, -1))
     return lse.reshape(n_n * bn)[:n]
 
 
 # ------------------------------------------------------------------ backward
 
 def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int,
-               w_vd: bool):
+               w_vd: bool, bv: int, v: int):
     ni = pl.program_id(0)
     vi = pl.program_id(1)
 
@@ -155,7 +158,7 @@ def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    logits, wt = _logits_tile(h_ref, w_ref, b_ref, w_vd)
+    logits, wt = _logits_tile(h_ref, w_ref, b_ref, w_vd, vi, bv, v)
     lse = lse_ref[0, ni, :]                                   # [bn]
     gp = jnp.exp(logits - lse[:, None]) * g_ref[0, ni, :][:, None]  # [bn, bv]
     dims = (((1,), (0,)), ((), ())) if w_vd else (((1,), (1,)), ((), ()))
@@ -169,7 +172,9 @@ def _dh_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dh_ref, acc_ref, *, n_v: int
 
 
 def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
-                 dw_acc, db_acc, *, n_n: int, w_vd: bool):
+                 dw_acc, db_acc, *, n_n: int, w_vd: bool, bn: int, bv: int,
+                 n: int, v: int):
+    vi = pl.program_id(0)
     ni = pl.program_id(1)  # read at top level: program_id is invalid inside when-bodies in interpret mode
 
     @pl.when(ni == 0)
@@ -177,17 +182,24 @@ def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
         dw_acc[:] = jnp.zeros_like(dw_acc)
         db_acc[:] = jnp.zeros_like(db_acc)
 
-    logits, _ = _logits_tile(h_ref, w_ref, b_ref, w_vd)       # [bn, bv]
+    logits, _ = _logits_tile(h_ref, w_ref, b_ref, w_vd, vi, bv, v)  # [bn, bv]
     lse = lse_ref[0, ni, :]
     gp = jnp.exp(logits - lse[:, None]) * g_ref[0, ni, :][:, None]
-    gph = gp.astype(h_ref.dtype)
+    # The dw/db contraction runs over the row (token) axis, so the ragged last
+    # row block's undefined lanes must be hard zeros on BOTH operands: gp rows
+    # (g pads to 0, but 0 * garbage-inf logits would be NaN) and h rows.
+    row = ni * bn + jax.lax.broadcasted_iota(jnp.int32, gp.shape, 0)
+    gp = jnp.where(row < n, gp, 0.0)
+    hrow = ni * bn + jax.lax.broadcasted_iota(jnp.int32, h_ref.shape, 0)
+    ht = jnp.where(hrow < n, h_ref[...], jnp.zeros((), h_ref.dtype))
+    gph = gp.astype(ht.dtype)
     if w_vd:
         dw_acc[:] += jax.lax.dot_general(                     # [bv, d]
-            gph, h_ref[...], (((0,), (0,)), ((), ())),
+            gph, ht, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
     else:
         dw_acc[:] += jax.lax.dot_general(                     # [d, bv]
-            h_ref[...], gph, (((0,), (0,)), ((), ())),
+            ht, gph, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
     db_acc[:, :] += jnp.broadcast_to(gp.sum(axis=0)[None, :], db_acc.shape)
 
@@ -198,17 +210,17 @@ def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
 
 
 def _backward(h, w, b, lse, g, bn, bv, interpret, w_vd):
-    n, d = h.shape
-    v = w.shape[0] if w_vd else w.shape[1]
-    hp, wp, bp, n_n, n_v = _pad_inputs(h, w, b, bn, bv, w_vd)
+    n, d, v, n_n, n_v = _shapes(h, w, bn, bv, w_vd)
+    bvec = b.reshape(1, -1)
+    # The lse/g planes are tiny [N] vectors; padding THEM is cheap (unlike the
+    # table). Padding rows must contribute nothing: gradient pads as zero AND
+    # lse pads large-positive so exp underflows (see _PAD_LSE).
     lse_p = jnp.pad(lse, (0, n_n * bn - n),
                     constant_values=_PAD_LSE).reshape(1, n_n, bn)
-    # Padding rows must contribute nothing: their incoming gradient pads as zero
-    # AND their lse pads large-positive so exp underflows (see _PAD_LSE).
     g_p = jnp.pad(g.astype(jnp.float32), (0, n_n * bn - n)).reshape(1, n_n, bn)
 
     dh = pl.pallas_call(
-        functools.partial(_dh_kernel, n_v=n_v, w_vd=w_vd),
+        functools.partial(_dh_kernel, n_v=n_v, w_vd=w_vd, bv=bv, v=v),
         grid=(n_n, n_v),
         in_specs=[
             pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
@@ -218,15 +230,16 @@ def _backward(h, w, b, lse, g, bn, bv, interpret, w_vd):
             pl.BlockSpec((1, n_n, bn), lambda i, j: (0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n_n * bn, d), h.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, d), h.dtype),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
         interpret=interpret,
-    )(hp, wp, bp, lse_p, g_p)[:n]
+    )(h, w, bvec, lse_p, g_p)
 
-    dw_shape = (n_v * bv, d) if w_vd else (d, n_v * bv)
+    dw_shape = (v, d) if w_vd else (d, v)
     dw_scratch = pltpu.VMEM((bv, d) if w_vd else (d, bv), jnp.float32)
     dw, db = pl.pallas_call(
-        functools.partial(_dwdb_kernel, n_n=n_n, w_vd=w_vd),
+        functools.partial(_dwdb_kernel, n_n=n_n, w_vd=w_vd, bn=bn, bv=bv,
+                          n=n, v=v),
         grid=(n_v, n_n),
         in_specs=[
             pl.BlockSpec((bn, d), lambda j, i: (i, 0)),
@@ -241,16 +254,15 @@ def _backward(h, w, b, lse, g, bn, bv, interpret, w_vd):
         ),
         out_shape=(
             jax.ShapeDtypeStruct(dw_shape, w.dtype),
-            jax.ShapeDtypeStruct((1, n_v * bv), jnp.float32),
+            jax.ShapeDtypeStruct((1, v), jnp.float32),
         ),
         scratch_shapes=[
             dw_scratch,
             pltpu.VMEM((_LANES, bv), jnp.float32),
         ],
         interpret=interpret,
-    )(hp, wp, bp, lse_p, g_p)
-    dw = dw[:v, :] if w_vd else dw[:, :v]
-    return dh, dw, db[0, :v]
+    )(h, w, bvec, lse_p, g_p)
+    return dh, dw, db[0]
 
 
 # ----------------------------------------------------------------- public op

@@ -37,12 +37,18 @@ def main():
     cfg = transformer_lm.TransformerLMConfig(
         vocab_size=32_000, d_model=512, n_heads=8, n_layers=6, d_ff=2048,
         max_len=512, dtype=jnp.bfloat16 if on_accel else jnp.float32,
-        tied_output=False)
-    # Swept on a v5e chip (bf16 lm_head halves the logits tensor, so larger
-    # batches fit than the first-round sweep found): 256/device = ~404k tokens/s
-    # vs 389k at 128 and 381k at 96; 384/device OOMs; seq512 loses (346k at 128).
+        tied_output=False,
+        # Pallas fused head+loss (logits never materialized): measured faster
+        # than the XLA head at equal batch (410k vs 398k tokens/s at 256) AND
+        # it unlocks batch 384, which OOMs with materialized logits. Gated on
+        # the platforms whose Mosaic backend compiles the kernels — elsewhere
+        # (GPU) pallas would run in interpret mode and crater the bench.
+        fused_head=jax.default_backend() in ("tpu", "axon"))
+    # Swept on a v5e chip: fused head 384/device = ~426k tokens/s vs 410k at
+    # 256 and 421k at 512; XLA head topped out at ~404k (bs 256; 384 OOMs);
+    # seq512 loses (346k at 128).
     seq_len = 256 if on_accel else 64
-    batch_size = (256 if on_accel else 8) * n_dev
+    batch_size = (384 if on_accel else 8) * n_dev
 
     model, params = transformer_lm.init_params(cfg)
     loss_fn = transformer_lm.make_loss_fn(model)

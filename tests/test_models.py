@@ -28,6 +28,28 @@ def test_transformer_lm_trains_allreduce():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.parametrize("tied", [False, True])
+def test_transformer_lm_fused_head_matches_xla_head(tied):
+    """fused_head=True (pallas head+loss) must equal the XLA-head loss and
+    produce the same training trajectory, tied and untied."""
+    cfg = dataclasses.replace(TINY_LM, tied_output=tied)
+    cfg_f = dataclasses.replace(cfg, fused_head=True)
+    model, params = transformer_lm.init_params(cfg)
+    model_f, _ = transformer_lm.init_params(cfg_f)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=8, seq_len=16)
+    l_xla = float(transformer_lm.make_loss_fn(model)(params, batch))
+    l_fused = float(transformer_lm.make_loss_fn(model_f)(params, batch))
+    np.testing.assert_allclose(l_fused, l_xla, rtol=1e-5)
+
+    def run(m):
+        ad = AutoDist(strategy_builder=AllReduce())
+        step = ad.function(transformer_lm.make_loss_fn(m), params,
+                           optax.adam(1e-2), example_batch=batch)
+        return [float(step(batch)) for _ in range(4)]
+
+    np.testing.assert_allclose(run(model_f), run(model), rtol=5e-4, atol=5e-4)
+
+
 def test_transformer_lm_embedding_detected_sparse_and_parallax_routes_it():
     # Untied output: the embedding is gather-only (like the reference lm1b model's
     # separate softmax weights), so its gradient is row-sparse.
