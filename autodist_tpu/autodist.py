@@ -257,4 +257,9 @@ class AutoDist:
 
         step.runner = runner
         step.get_state = lambda: state
+        if not runner.plan.is_async:
+            # Sync runner only: the async regime's worker-side local state is a
+            # pass-through template (the chief's PS state is authoritative), so
+            # an inherited evaluate would silently score untrained params.
+            step.evaluate = lambda batch, fn=None: runner.evaluate(state, batch, fn)
         return step

@@ -128,6 +128,7 @@ class DistributedRunner:
         # Compiled steps keyed by fetch fn (None = plain step); reference cached
         # one built runner per graph the same way (autodist.py:280-287).
         self._step_fns: dict = {}
+        self._eval_fns: dict = {}
         self._state_shardings = None
 
     def _mesh_from_plan(self) -> Mesh:
@@ -264,7 +265,8 @@ class DistributedRunner:
                 "(each new identity recompiles the whole training step)")
         return jitted
 
-    def shard_batch(self, batch: PyTree) -> PyTree:
+    def shard_batch(self, batch: PyTree,
+                    accumulation: Optional[int] = None) -> PyTree:
         """Feed remapping: split batch leaves across data replicas, duplicate the
         rest (reference remapper.py:81-123 semantics, with the polymorphic dim now
         'leading dim divisible by dp_size').
@@ -272,9 +274,11 @@ class DistributedRunner:
         With gradient accumulation (``accumulation_steps=k``), splittable leaves
         are additionally laid out ``[k, B/k, ...]`` (wrapped in ``MicroBatched``)
         so the compiled step can scan micro-batches; the reshape happens on the
-        host, before placement, so it moves no device data."""
+        host, before placement, so it moves no device data. ``accumulation``
+        overrides the runner's setting (evaluate() passes 1 — the micro layout
+        only shapes the training scan)."""
         dp = synchronization.mesh_dp_size(self.mesh)
-        k = self._accum
+        k = self._accum if accumulation is None else accumulation
 
         # Which leaves are *batch* leaves for micro-splitting: those whose leading
         # dim equals the global batch size, taken as the largest leading dim in the
@@ -352,6 +356,42 @@ class DistributedRunner:
         if fetches is not None:
             return new_state, (default, fetched)
         return new_state, default
+
+    def evaluate(self, state: TrainState, batch: PyTree,
+                 fn: Optional[Callable] = None):
+        """Forward-only compiled evaluation — no gradients, no update, no
+        donation; ``state`` stays valid and unchanged.
+
+        ``fn(params, batch) -> pytree`` defaults to the loss function. Params
+        are presented at logical (unpadded) shapes, like the training step.
+        The reference evaluated by session-running non-train fetches
+        (remapper.py:125-185 master-replica contraction); here it is its own
+        tiny compiled program, cached per ``fn`` identity.
+        """
+        if self._state_shardings is None:
+            raise RuntimeError("Call init(params) before evaluate()")
+        fn = fn if fn is not None else self._loss_fn
+        jitted = self._eval_fns.get(fn)
+        if jitted is None:
+            unpad = self.plan.unpad_params if self.plan.has_padding else (lambda t: t)
+            jitted = jax.jit(lambda p, b: fn(unpad(p), b),
+                             in_shardings=(self._state_shardings.params, None))
+            self._eval_fns[fn] = jitted
+            if len(self._eval_fns) > 8:
+                # Never evict the default (loss) entry — it is the hot path.
+                evict = next(k for k in self._eval_fns if k is not self._loss_fn)
+                del self._eval_fns[evict]
+                logging.warning(
+                    "More than 8 distinct evaluate() callables compiled; pass a "
+                    "stable function instead of per-call lambdas")
+        # A batch pre-sharded for an accumulating run() carries MicroBatched
+        # [k, B/k, ...] leaves — fold them back to the logical layout first.
+        batch = jax.tree_util.tree_map(
+            lambda l: l.value.reshape((-1,) + l.value.shape[2:]) if _is_micro(l)
+            else l, batch, is_leaf=_is_micro)
+        sharded = self.shard_batch(batch, accumulation=1)
+        with self.mesh:
+            return jitted(state.params, sharded)
 
     def _maybe_dump_graphs(self, state: TrainState, sharded_batch: PyTree,
                            step_fn: Callable):

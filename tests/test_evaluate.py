@@ -1,0 +1,115 @@
+"""Forward-only evaluation: no update, no donation, logical shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist
+from autodist_tpu.strategy import AllReduce, PS, UnevenPartitionedPS
+
+
+def _loss(p, b):
+    return jnp.mean((b["y"] - (b["x"] @ p["w"] + p["b"])) ** 2)
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {"w": rng.randn(5, 1).astype(np.float32), "b": np.zeros((1,), np.float32)}
+
+
+def _batch(seed=1):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(32, 5).astype(np.float32),
+            "y": rng.randn(32, 1).astype(np.float32)}
+
+
+def _runner(strategy=None, **kw):
+    ad = AutoDist(strategy_builder=strategy or AllReduce())
+    return ad.create_distributed_session(_loss, _params(), optax.sgd(0.1),
+                                         example_batch=_batch(), **kw)
+
+
+@pytest.mark.parametrize("strategy_cls", [AllReduce, PS, UnevenPartitionedPS])
+def test_evaluate_matches_loss_and_mutates_nothing(strategy_cls):
+    runner = _runner(strategy_cls())
+    state = runner.init(_params())
+    batch = _batch()
+    expected = float(_loss({k: jnp.asarray(v) for k, v in _params().items()},
+                           {k: jnp.asarray(v) for k, v in batch.items()}))
+    got = float(runner.evaluate(state, batch))
+    assert got == pytest.approx(expected, rel=1e-6)
+    # evaluate() does not donate or mutate: repeated calls on the same state
+    # keep working and agree, and the state then trains normally.
+    assert float(runner.evaluate(state, batch)) == pytest.approx(expected, rel=1e-6)
+    p_before = jax.device_get(runner.logical_params(state))
+    state2, _ = runner.run(state, batch)  # run() donates `state`, as documented
+    p_after = jax.device_get(runner.logical_params(state2))
+    assert not np.allclose(p_before["w"], p_after["w"])  # run() did update
+    assert float(runner.evaluate(state2, batch)) < got    # eval sees new params
+
+
+def test_evaluate_custom_fn_returns_predictions():
+    runner = _runner()
+    state = runner.init(_params())
+    batch = _batch()
+    preds = runner.evaluate(state, batch, fn=lambda p, b: b["x"] @ p["w"] + p["b"])
+    assert preds.shape == (32, 1)
+    expected = batch["x"] @ _params()["w"] + _params()["b"]
+    np.testing.assert_allclose(jax.device_get(preds), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_evaluate_skips_micro_batching():
+    runner = _runner(accumulation_steps=4)
+    state = runner.init(_params())
+    got = float(runner.evaluate(state, _batch()))
+    plain = _runner()
+    s2 = plain.init(_params())
+    assert got == pytest.approx(float(plain.evaluate(s2, _batch())), rel=1e-6)
+
+
+def test_evaluate_accepts_presharded_accumulation_batch():
+    """A batch pre-sharded for an accumulating run() (MicroBatched leaves)
+    folds back to logical layout inside evaluate()."""
+    runner = _runner(accumulation_steps=4)
+    state = runner.init(_params())
+    sharded = runner.shard_batch(_batch())  # carries MicroBatched leaves
+    got = float(runner.evaluate(state, sharded))
+    assert got == pytest.approx(float(runner.evaluate(state, _batch())), rel=1e-6)
+
+
+def test_evaluate_does_not_disturb_accumulating_run():
+    """shard_batch takes the micro factor as a parameter, so evaluate() cannot
+    race a concurrent run()'s sharding; interleaved calls stay value-exact."""
+    runner = _runner(accumulation_steps=4)
+    plain = _runner()
+    s_a, s_p = runner.init(_params()), plain.init(_params())
+    for i in range(3):
+        runner.evaluate(s_a, _batch(7))    # interleaved eval between steps
+        s_a, _ = runner.run(s_a, _batch(i))
+        s_p, _ = plain.run(s_p, _batch(i))
+    a = jax.device_get(runner.logical_params(s_a))
+    p = jax.device_get(plain.logical_params(s_p))
+    for k in p:
+        np.testing.assert_allclose(a[k], p[k], rtol=2e-6, atol=2e-6)
+
+
+def test_async_step_has_no_evaluate():
+    """The async regime's worker-local state is a pass-through template; a
+    step.evaluate there would score untrained params, so it is not attached."""
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    step = ad.function(_loss, _params(), optax.sgd(0.1), example_batch=_batch())
+    assert not hasattr(step, "evaluate")
+    step.runner.close() if hasattr(step.runner, "close") else None
+
+
+def test_function_step_evaluate_tracks_training():
+    ad = AutoDist(strategy_builder=AllReduce())
+    step = ad.function(_loss, _params(), optax.sgd(0.1), example_batch=_batch())
+    batch = _batch()
+    before = float(step.evaluate(batch))
+    for _ in range(10):
+        step(batch)
+    after = float(step.evaluate(batch))
+    assert after < before  # sees the trained (current) state
