@@ -262,6 +262,24 @@ class AsyncPSRunner(DistributedRunner):
                                        listen_sock=self._ps_listen_sock)
         return state
 
+    def evaluate(self, state: TrainState, batch: PyTree, fn=None):
+        """Forward-only evaluation against the AUTHORITATIVE parameters.
+
+        The chief evaluates the parameter service's current state (the passed
+        ``state`` is a drop-in-API artifact — in the async regime the service
+        owns the params). Remote worker processes hold only a shape template
+        locally, so evaluating there would silently score untrained params:
+        they raise instead."""
+        if self._is_remote_worker:
+            raise RuntimeError(
+                "evaluate() is not available on async worker processes: the "
+                "local state is a compile-shapes template; the chief's "
+                "parameter service owns the authoritative parameters. "
+                "Evaluate on the chief process")
+        if self.service is not None:
+            state = self.service.state
+        return super().evaluate(state, batch, fn)
+
     def _apply(self, state: TrainState, grads: PyTree) -> TrainState:
         import optax
         updates, opt_state = self._optimizer.update(grads, state.opt_state, state.params)

@@ -104,6 +104,27 @@ def test_async_step_has_no_evaluate():
     step.runner.close() if hasattr(step.runner, "close") else None
 
 
+def test_async_runner_evaluates_authoritative_state_on_chief():
+    """runner.evaluate in the async regime scores the parameter service's
+    CURRENT state, not the caller's possibly stale handle."""
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.1),
+                                           example_batch=_batch())
+    try:
+        state0 = runner.init(_params())
+        batch = _batch()
+        before = float(runner.evaluate(state0, batch))
+        s = state0
+        for _ in range(10):
+            s, _ = runner.run(s, batch)
+        # Pass the ORIGINAL (stale) handle: must still reflect training.
+        after = float(runner.evaluate(state0, batch))
+        assert after < before
+    finally:
+        if hasattr(runner, "close"):
+            runner.close()
+
+
 def test_function_step_evaluate_tracks_training():
     ad = AutoDist(strategy_builder=AllReduce())
     step = ad.function(_loss, _params(), optax.sgd(0.1), example_batch=_batch())

@@ -19,6 +19,16 @@ def _ref_lse(h, w, b):
     return jax.nn.logsumexp(logits, axis=-1)
 
 
+def _f32_tol(rtol=1e-5, atol=1e-5):
+    """f32 comparison tolerance: exact-ish on CPU (the given values); on
+    TPU-class backends both the kernel and the XLA reference run f32 matmuls
+    at MXU (bf16-pass) precision, so two correct implementations legitimately
+    differ by ~1e-3. The backend membership test lives here exactly once."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return dict(rtol=5e-3, atol=5e-3)
+    return dict(rtol=rtol, atol=atol)
+
+
 def _data(n, d, v, dtype, seed=0):
     rng = np.random.RandomState(seed)
     h = jnp.asarray(rng.randn(n, d), dtype) * 0.5
@@ -80,7 +90,7 @@ def test_fused_xent_matches_composed_loss():
     logits = h @ w + b
     expected = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
                                     targets[:, None], axis=-1)[:, 0]
-    np.testing.assert_allclose(nll, expected, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(nll, expected, **_f32_tol())
 
     # Full loss gradient (both the lse and the gathered true-logit paths).
     gf = jax.grad(lambda h, w: jnp.mean(fused_softmax_xent(h, w, targets, b,
@@ -90,8 +100,9 @@ def test_fused_xent_matches_composed_loss():
         lambda h, w: jnp.mean(-jnp.take_along_axis(
             jax.nn.log_softmax(h @ w + b, axis=-1),
             targets[:, None], axis=-1)[:, 0]), argnums=(0, 1))(h, w)
+    tol = _f32_tol(rtol=2e-4, atol=2e-5)
     for a, e in zip(gf, gr):
-        np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(a, e, **tol)
 
 
 def test_vd_layout_matches_dv():
