@@ -10,6 +10,7 @@ routes to PS (reference routed lm1b's embedding the same way).
 """
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -165,24 +166,31 @@ class TransformerLM(nn.Module):
                         name="lm_head")(x)
 
 
+def fused_head_nll(model: TransformerLM, params, inputs, targets,
+                   pos_offset=0) -> jax.Array:
+    """Per-token NLL [B, T] through the fused pallas head+loss
+    (``ops/fused_xent``): the single definition of which param is the head
+    table and in which layout — shared by :func:`make_loss_fn` and the
+    sequence-parallel loss (``parallel/sequence.py``), so the two paths can
+    never encode different objectives."""
+    from autodist_tpu.ops.fused_xent import fused_softmax_xent
+    h = model.apply({"params": params}, inputs, pos_offset=pos_offset,
+                    return_hidden=True)
+    h2 = h.reshape(-1, h.shape[-1])
+    if model.config.tied_output:
+        # Tied head: the table is the [V, D] embedding itself.
+        nll = fused_softmax_xent(h2, params["embed"]["embedding"],
+                                 targets.reshape(-1), w_layout="vd")
+    else:
+        nll = fused_softmax_xent(h2, params["lm_head"]["kernel"],
+                                 targets.reshape(-1))
+    return nll.reshape(targets.shape)
+
+
 def make_loss_fn(model: TransformerLM) -> Callable:
     """Next-token cross entropy; batch = {"tokens": int32 [B, L+1]} (inputs/targets
     shifted internally). Matches the reference's lm1b objective shape (words/sec is
     counted over target tokens, lm1b_train.py:64-74)."""
-
-    def fused_nll(params, inputs, targets):
-        from autodist_tpu.ops.fused_xent import fused_softmax_xent
-        h = model.apply({"params": params}, inputs, return_hidden=True)
-        n = h.shape[0] * h.shape[1]
-        h2 = h.reshape(n, h.shape[-1])
-        if model.config.tied_output:
-            # Tied head: the table is the [V, D] embedding itself.
-            nll = fused_softmax_xent(h2, params["embed"]["embedding"],
-                                     targets.reshape(n), w_layout="vd")
-        else:
-            nll = fused_softmax_xent(h2, params["lm_head"]["kernel"],
-                                     targets.reshape(n))
-        return nll.reshape(targets.shape)
 
     def xla_nll(params, inputs, targets):
         logits = model.apply({"params": params}, inputs)
@@ -191,7 +199,8 @@ def make_loss_fn(model: TransformerLM) -> Callable:
         logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
 
-    per_token_nll = fused_nll if model.config.fused_head else xla_nll
+    per_token_nll = (functools.partial(fused_head_nll, model)
+                     if model.config.fused_head else xla_nll)
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]

@@ -45,12 +45,25 @@ def make_sequence_parallel_loss_fn(model, mesh: Mesh) -> Callable:
     tok_spec = P(plan_lib.DP_AXES, const.MESH_AXIS_SEQ)
     max_len = getattr(getattr(model, "config", None), "max_len", None)
 
+    fused_head = bool(getattr(getattr(model, "config", None), "fused_head", False))
+
     def local_loss(params, inputs, targets):
         l_local = inputs.shape[1]
         offset = jax.lax.axis_index(const.MESH_AXIS_SEQ) * l_local
-        logits = model.apply({"params": params}, inputs, pos_offset=offset)
-        logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+        if fused_head:
+            # Per-shard rows are independent tokens, so the fused pallas
+            # head+loss (ops/fused_xent) composes with sequence sharding as-is
+            # — each shard scores its own tokens, logits never materialize.
+            # One shared definition of the head table/layout lives in
+            # transformer_lm.fused_head_nll.
+            from autodist_tpu.models.transformer_lm import fused_head_nll
+            nll = fused_head_nll(model, params, inputs, targets,
+                                 pos_offset=offset)
+        else:
+            logits = model.apply({"params": params}, inputs, pos_offset=offset)
+            logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logprobs, targets[..., None],
+                                       axis=-1)[..., 0]
         # Global token mean: psum local sums over every batch/sequence shard.
         total = jax.lax.psum(nll.sum(), _SP_AXES)
         count = jax.lax.psum(jnp.float32(nll.size), _SP_AXES)

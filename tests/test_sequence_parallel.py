@@ -61,6 +61,35 @@ def test_sp_loss_and_grads_match_single_device():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("tied", [False, True])
+def test_sp_fused_head_matches_plain_sp(tied):
+    """The fused pallas head composes with sequence parallelism: same loss and
+    gradients as the SP path with the XLA head — tied (embedding-table head,
+    vd layout, gradient summing gather + fused dw) and untied."""
+    import dataclasses
+    _, _, cfg = _model("ring")
+    cfg = dataclasses.replace(cfg, tied_output=tied)
+    model_ring, params = transformer_lm.init_params(cfg)
+    cfg_f = dataclasses.replace(cfg, fused_head=True)
+    model_fused = transformer_lm.TransformerLM(cfg_f)
+    batch = _batch(cfg)
+
+    ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=4))
+    runner = create_sequence_parallel_session(ad, model_ring, params,
+                                              optax.sgd(0.1))
+    loss_plain = make_sequence_parallel_loss_fn(model_ring, runner.mesh)
+    loss_fused = make_sequence_parallel_loss_fn(model_fused, runner.mesh)
+    state = runner.init(params)
+    p = runner.logical_params(state)
+    with runner.mesh:
+        lp, gp = jax.value_and_grad(loss_plain)(p, batch)
+        lf, gf = jax.value_and_grad(loss_fused)(p, batch)
+    np.testing.assert_allclose(float(lf), float(lp), rtol=1e-5)
+    for a, e in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_sp_training_decreases_loss():
     model, params, cfg = _model("ring")
     batch = _batch(cfg)
