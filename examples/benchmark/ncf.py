@@ -28,13 +28,13 @@ def main(argv=None):
     parser.add_argument("--resource_spec", type=str, default=None)
     args = parser.parse_args(argv)
 
-    n_dev = len(jax.devices())
     # NCF is gather-bound: per-step dispatch dominates at small batches, so
     # throughput scales nearly linearly with batch (v5e sweep: 172k ex/s at
-    # 1024, 1.26M at 8k, 7.9M at 64k — still converging; 256k trains less
-    # stably at this lr). The reference's NCF likewise ran very large batches.
-    # Capped: 256k+ global batches train unstably at this fixed lr.
-    batch_size = args.batch_size or min(65536 * n_dev, 131072)
+    # 1024, 1.26M at 8k, 7.9M at 64k — still converging; 256k+ trains
+    # unstably at this fixed lr). The reference's NCF likewise ran very large
+    # batches. The default is the measured 64k GLOBAL batch whatever the
+    # device count — scale explicitly (with the lr) for bigger sweeps.
+    batch_size = args.batch_size or 65536
 
     cfg = ncf.NeuMFConfig()
     model = ncf.NeuMF(cfg)

@@ -1,0 +1,120 @@
+"""Run every measured benchmark config and print the README table's numbers.
+
+One command reproduces the performance claims (the reference's benchmark suite
+was likewise driven per-config by flags; this adds the sweep driver):
+
+    python examples/benchmark/run_all.py                 # everything (~20 min)
+    python examples/benchmark/run_all.py --only resnet50,bert_base
+    python examples/benchmark/run_all.py --steps 30      # quicker, noisier
+
+Each config runs in a fresh subprocess (one AutoDist instance per process, the
+reference's own isolation rule) and reports its average throughput; results
+print as a table and optionally a JSON file.
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "..")
+
+# name -> (argv builder, unit, number regex over combined output)
+RATE = r"([\d,]+\.?\d*)"
+CONFIGS = {
+    "flagship": (lambda s: [os.path.join(ROOT, "bench.py")],
+                 "tokens/s", r'"value": ([\d.]+)'),
+    "resnet50": (lambda s: [os.path.join(ROOT, "examples/benchmark/imagenet.py"),
+                            "--model", "resnet50", "--strategy", "AllReduce",
+                            "--batch_size", "256", "--steps", s, "--log_every", s],
+                 "examples/s", RATE + r" examples/sec"),
+    "vgg16": (lambda s: [os.path.join(ROOT, "examples/benchmark/imagenet.py"),
+                         "--model", "vgg16", "--strategy", "PartitionedPS",
+                         "--batch_size", "128", "--steps", s, "--log_every", s],
+              "examples/s", RATE + r" examples/sec"),
+    "densenet121": (lambda s: [os.path.join(ROOT, "examples/benchmark/imagenet.py"),
+                               "--model", "densenet121", "--batch_size", "128",
+                               "--steps", s, "--log_every", s],
+                    "examples/s", RATE + r" examples/sec"),
+    "inceptionv3": (lambda s: [os.path.join(ROOT, "examples/benchmark/imagenet.py"),
+                               "--model", "inceptionv3", "--batch_size", "128",
+                               "--steps", s, "--log_every", s],
+                    "examples/s", RATE + r" examples/sec"),
+    "bert_base": (lambda s: [os.path.join(ROOT, "examples/benchmark/bert.py"),
+                             "--size", "base", "--batch_size", "128",
+                             "--steps", s, "--log_every", s],
+                  "examples/s", RATE + r" examples/sec"),
+    "bert_large": (lambda s: [os.path.join(ROOT, "examples/benchmark/bert.py"),
+                              "--size", "large", "--batch_size", "16",
+                              "--steps", s, "--log_every", s],
+                   "examples/s", RATE + r" examples/sec"),
+    "lm1b_lstm": (lambda s: [os.path.join(ROOT, "examples/lm1b/lm1b_train.py"),
+                             "--model", "lstm", "--steps", s, "--log_every", s],
+                  "words/s", RATE + r" words/sec"),
+    "ncf": (lambda s: [os.path.join(ROOT, "examples/benchmark/ncf.py"),
+                       "--steps", s, "--log_every", s],
+            "examples/s", RATE + r" examples/sec"),
+    "moe": (lambda s: [os.path.join(ROOT, "examples/moe_lm.py"),
+                       "--batch_size", "128", "--steps", s, "--log_every", s],
+            "tokens/s", RATE + r" tokens/sec"),
+}
+
+
+def run_config(name: str, steps: str):
+    builder, unit, pattern = CONFIGS[name]
+    cmd = [sys.executable] + builder(steps)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    out = proc.stdout + proc.stderr
+    if proc.returncode != 0:
+        return {"name": name, "unit": unit, "rate": None,
+                "error": out.strip().splitlines()[-1] if out.strip() else "failed"}
+    matches = re.findall(pattern, out)
+    if not matches:
+        return {"name": name, "unit": unit, "rate": None,
+                "error": "no rate found in output"}
+    rate = float(matches[-1].replace(",", ""))
+    return {"name": name, "unit": unit, "rate": rate, "error": None}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--only", type=str, default="",
+                        help="comma-separated subset of: " + ",".join(CONFIGS))
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--json", type=str, default="",
+                        help="also write results to this JSON file")
+    parser.add_argument("--list", action="store_true", help="list configs and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in CONFIGS:
+            print(name)
+        return []
+
+    names = [n.strip() for n in args.only.split(",") if n.strip()] or list(CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        parser.error(f"unknown configs {unknown}; valid: {sorted(CONFIGS)}")
+
+    results = []
+    for name in names:
+        print(f"running {name} ...", flush=True)
+        results.append(run_config(name, str(args.steps)))
+
+    width = max(len(r["name"]) for r in results)
+    print()
+    for r in results:
+        if r["rate"] is None:
+            print(f"{r['name']:<{width}}  FAILED: {r['error']}")
+        else:
+            print(f"{r['name']:<{width}}  {r['rate']:>14,.1f} {r['unit']}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    return results
+
+
+if __name__ == "__main__":
+    main()
