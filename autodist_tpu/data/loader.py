@@ -18,9 +18,7 @@ with the batch sharding) so host->HBM transfer also overlaps the step.
 """
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import threading
 from typing import Dict, Iterator, Optional
 
@@ -44,38 +42,25 @@ def _build_native() -> Optional[ctypes.CDLL]:
     with _BUILD_LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
-        src = _source_path()
-        try:
-            with open(src, "rb") as f:
-                tag = hashlib.sha256(f.read()).hexdigest()[:16]
-            out_dir = os.path.join(const.DEFAULT_WORKING_DIR, "native")
-            os.makedirs(out_dir, exist_ok=True)
-            lib_path = os.path.join(out_dir, f"loader-{tag}.so")
-            if not os.path.exists(lib_path):
-                tmp = lib_path + f".tmp{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
-                     src, "-lpthread"],
-                    check=True, capture_output=True)
-                os.replace(tmp, lib_path)  # atomic: concurrent builders race safely
-            lib = ctypes.CDLL(lib_path)
-            lib.dl_create.restype = ctypes.c_void_p
-            lib.dl_create.argtypes = [
-                ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
-                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
-            lib.dl_next.restype = ctypes.c_int
-            lib.dl_next.argtypes = [ctypes.c_void_p,
-                                    ctypes.POINTER(ctypes.c_void_p)]
-            lib.dl_epochs_completed.restype = ctypes.c_uint64
-            lib.dl_epochs_completed.argtypes = [ctypes.c_void_p]
-            lib.dl_destroy.restype = None
-            lib.dl_destroy.argtypes = [ctypes.c_void_p]
-            _LIB = lib
-        except Exception as e:  # no g++, sandboxed /tmp, ... -> numpy fallback
-            logging.warning("Native data loader unavailable (%s); "
-                            "using the numpy fallback", e)
+        from autodist_tpu.utils.native_build import build_native_lib
+        lib = build_native_lib(_source_path(), "loader",
+                               extra_flags=("-O3", "-lpthread"))
+        if lib is None:
             _LIB_FAILED = True
+            return None
+        lib.dl_create.restype = ctypes.c_void_p
+        lib.dl_create.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_void_p)]
+        lib.dl_epochs_completed.restype = ctypes.c_uint64
+        lib.dl_epochs_completed.argtypes = [ctypes.c_void_p]
+        lib.dl_destroy.restype = None
+        lib.dl_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
         return _LIB
 
 

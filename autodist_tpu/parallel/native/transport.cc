@@ -1,0 +1,120 @@
+// Native framed-message data plane for the PS transport.
+//
+// The reference delegated its PS plane to TensorFlow's C++ grpc runtime
+// (SURVEY.md §2.4); here the Python protocol layer (pickle, versioning,
+// staleness gate) stays Python and this library owns the bytes-on-the-wire
+// hot path: one writev for header+payload (the Python fallback concatenates,
+// copying the whole multi-MB payload), and one malloc + full-read loop for
+// receive (the fallback accumulates chunks through a Python loop). Calls run
+// with the GIL released (ctypes).
+//
+// Framing matches the Python fallback exactly — 8-byte big-endian length then
+// payload — so native and fallback endpoints interoperate freely.
+//
+// Build: g++ -O2 -shared -fPIC transport.cc -o transport.so  (done lazily by
+// ps_transport.py, like data/native/loader.cc).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+uint64_t to_be64(uint64_t v) {
+  const uint16_t probe = 1;
+  if (*reinterpret_cast<const uint8_t*>(&probe) == 0) return v;  // big-endian
+  uint64_t r = 0;
+  for (int i = 0; i < 8; ++i) r = (r << 8) | ((v >> (8 * i)) & 0xff);
+  return r;
+}
+
+// Full-read loop; returns 0 on success, -1 on EOF/error, -2 when interrupted
+// by a signal BEFORE any byte moved (so Python can run signal handlers at a
+// clean message boundary and retry; mid-message interrupts retry here — the
+// peer has committed to the message and it completes in bounded time).
+int read_exact(int fd, void* buf, size_t n, bool* started) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) return -1;                       // peer closed
+    if (r < 0) {
+      if (errno == EINTR) {
+        if (!*started) return -2;
+        continue;
+      }
+      return -1;
+    }
+    *started = true;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Send one framed message (header + payload) with writev; loops until done.
+// Returns 0 on success, -1 on error, -2 when a signal arrived before any byte
+// was written (caller retries from Python so signal handlers run).
+int tr_send(int fd, const void* buf, uint64_t n) {
+  uint64_t hdr = to_be64(n);
+  struct iovec iov[2];
+  iov[0].iov_base = &hdr;
+  iov[0].iov_len = sizeof(hdr);
+  iov[1].iov_base = const_cast<void*>(buf);
+  iov[1].iov_len = static_cast<size_t>(n);
+  int idx = 0;
+  bool started = false;
+  while (idx < 2) {
+    ssize_t w = ::writev(fd, &iov[idx], 2 - idx);
+    if (w < 0) {
+      if (errno == EINTR) {
+        if (!started) return -2;
+        continue;
+      }
+      return -1;
+    }
+    if (w > 0) started = true;
+    auto remaining = static_cast<size_t>(w);
+    while (idx < 2 && remaining >= iov[idx].iov_len) {
+      remaining -= iov[idx].iov_len;
+      ++idx;
+    }
+    if (idx < 2 && remaining > 0) {
+      iov[idx].iov_base = static_cast<uint8_t*>(iov[idx].iov_base) + remaining;
+      iov[idx].iov_len -= remaining;
+    }
+  }
+  return 0;
+}
+
+// Receive one framed message. On success returns the payload length and sets
+// *out to a malloc'd buffer (caller frees via tr_free). Returns -1 on
+// EOF/error, -2 when a signal arrived before any byte of the message was read
+// (caller retries from Python). No buffer is allocated on either error.
+int64_t tr_recv(int fd, void** out) {
+  uint64_t hdr;
+  bool started = false;
+  int rc = read_exact(fd, &hdr, sizeof(hdr), &started);
+  if (rc != 0) return rc;
+  uint64_t n = to_be64(hdr);
+  void* buf = std::malloc(n ? static_cast<size_t>(n) : 1);
+  if (buf == nullptr) return -1;
+  if (n && read_exact(fd, buf, static_cast<size_t>(n), &started) != 0) {
+    std::free(buf);
+    return -1;
+  }
+  *out = buf;
+  return static_cast<int64_t>(n);
+}
+
+void tr_free(void* p) { std::free(p); }
+
+}  // extern "C"

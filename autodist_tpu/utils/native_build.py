@@ -1,0 +1,44 @@
+"""Shared lazy build-and-load for the native C++ extensions.
+
+One definition of the compile recipe (content-hashed cache under the working
+dir, pid-suffixed temp + atomic rename so concurrent builders race safely,
+warning + ``None`` fallback when no compiler is available) used by the data
+loader (``data/loader.py``) and the PS transport (``parallel/ps_transport.py``).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+
+def build_native_lib(src_path: str, name: str,
+                     extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Compile ``src_path`` into a cached shared library and load it.
+
+    Returns ``None`` (after logging a warning) when the toolchain or filesystem
+    is unavailable — callers fall back to their pure-Python paths. The cache key
+    is the source content hash, so editing the .cc rebuilds automatically.
+    """
+    try:
+        with open(src_path, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        out_dir = os.path.join(const.DEFAULT_WORKING_DIR, "native")
+        os.makedirs(out_dir, exist_ok=True)
+        lib_path = os.path.join(out_dir, f"{name}-{tag}.so")
+        if not os.path.exists(lib_path):
+            tmp = lib_path + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+                 src_path, *extra_flags],
+                check=True, capture_output=True)
+            os.replace(tmp, lib_path)  # atomic: concurrent builders race safely
+        return ctypes.CDLL(lib_path)
+    except Exception as e:  # no g++, sandboxed tmp, ... -> pure-Python fallback
+        logging.warning("Native %s unavailable (%s); using the Python fallback",
+                        name, e)
+        return None

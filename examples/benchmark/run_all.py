@@ -82,7 +82,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--only", type=str, default="",
                         help="comma-separated subset of: " + ",".join(CONFIGS))
-    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--steps", type=int, default=60,
+                        help="steps per config (flagship runs bench.py, which "
+                             "has its own fixed length and ignores this)")
     parser.add_argument("--json", type=str, default="",
                         help="also write results to this JSON file")
     parser.add_argument("--list", action="store_true", help="list configs and exit")
