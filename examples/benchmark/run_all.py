@@ -32,7 +32,7 @@ CONFIGS = {
                  "examples/s", RATE + r" examples/sec"),
     "vgg16": (lambda s: [os.path.join(ROOT, "examples/benchmark/imagenet.py"),
                          "--model", "vgg16", "--strategy", "PartitionedPS",
-                         "--batch_size", "128", "--steps", s, "--log_every", s],
+                         "--batch_size", "256", "--steps", s, "--log_every", s],
               "examples/s", RATE + r" examples/sec"),
     "densenet121": (lambda s: [os.path.join(ROOT, "examples/benchmark/imagenet.py"),
                                "--model", "densenet121", "--batch_size", "128",

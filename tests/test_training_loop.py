@@ -134,6 +134,21 @@ def test_dash_prefix_names_do_not_collide(tmp_path):
     assert int(resumed.step) == 5  # resumed gen-3, not gen-ema-7
 
 
+def test_train_consumes_dataloader():
+    """The native/fallback DataLoader's iterator plugs into train() directly
+    (the host data pipeline and the loop compose)."""
+    from autodist_tpu.data.loader import DataLoader
+    rng = np.random.RandomState(5)
+    loader = DataLoader({"x": rng.randn(96, 4).astype(np.float32),
+                         "y": rng.randn(96, 1).astype(np.float32)},
+                        batch_size=32)
+    try:
+        state = train(_runner(), _params(), iter(loader), steps=6, log_every=0)
+        assert int(state.step) == 6  # continuous stream: never exhausts
+    finally:
+        loader.close()
+
+
 def test_metrics_callback_fires():
     seen = []
     train(_runner(), _params(), _batch_fn, steps=7, log_every=3,
