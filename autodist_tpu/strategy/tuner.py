@@ -65,7 +65,8 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                   resource_spec: Optional[ResourceSpec] = None,
                   warmup_steps: int = 2, measure_steps: int = 8,
                   sparse_names: Optional[Sequence[str]] = None,
-                  has_aux: bool = False) -> TuneResult:
+                  has_aux: bool = False,
+                  accumulation_steps: int = 1) -> TuneResult:
     """Measure each candidate builder on the real (model, batch, devices).
 
     Returns the fastest builder plus the full ranking; pass ``result.best`` to
@@ -99,7 +100,8 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                 ad = AutoDist(resource_spec, builder)
                 runner = ad.create_distributed_session(
                     loss_fn, params, optimizer, example_batch=example_batch,
-                    sparse_names=sparse_names, has_aux=has_aux)
+                    sparse_names=sparse_names, has_aux=has_aux,
+                    accumulation_steps=accumulation_steps)
                 state = runner.init(params)
                 # Pre-place the batch: run()'s resident-array check then makes the
                 # per-step shard a no-op, so the timed loop measures the strategy,

@@ -32,15 +32,24 @@ def train(runner, params: PyTree,
           batch_size: Optional[int] = None,
           is_chief: Optional[bool] = None,
           resume: bool = True,
-          on_metrics: Optional[Callable[[int, float, float], None]] = None) -> TrainState:
+          on_metrics: Optional[Callable[[int, float, float], None]] = None,
+          eval_every: int = 0,
+          eval_batch: Any = None,
+          eval_fn: Optional[Callable] = None,
+          on_eval: Optional[Callable[[int, Any], None]] = None) -> TrainState:
     """Run ``steps`` global steps, checkpointing and resuming automatically.
 
     ``batches``: either ``fn(step_index) -> batch`` or an iterable of batches
     (exhaustion ends the run early). ``save_every``/final saves happen on the
     chief only (every process restores, so all resume in lockstep — the c10
     shared-filesystem protocol). ``on_metrics(step, loss, rate)`` fires every
-    ``log_every`` steps. Returns the final :class:`TrainState`.
+    ``log_every`` steps. With ``eval_every`` and ``eval_batch``, the runner's
+    forward-only :meth:`evaluate` runs every ``eval_every`` steps on the
+    current params (``eval_fn`` defaults to the loss) and ``on_eval(step,
+    value)`` receives the result. Returns the final :class:`TrainState`.
     """
+    if eval_every and eval_batch is None:
+        raise ValueError("eval_every needs an eval_batch")
     if is_chief is None:
         is_chief = const.is_chief_process()
     saver = Saver(max_to_keep=max_to_keep) if checkpoint_dir else None
@@ -103,6 +112,19 @@ def train(runner, params: PyTree,
                              step_i + 1, float(loss), rate)
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
+        if (eval_every and (step_i + 1) % eval_every == 0
+                and not getattr(runner, "_is_remote_worker", False)):
+            # Async remote workers skip: their local state is a compile-shapes
+            # template and AsyncPSRunner.evaluate raises there by design. Sync
+            # SPMD processes all evaluate together (the compiled eval is a
+            # collective program).
+            val = runner.evaluate(state, eval_batch, eval_fn)
+            try:
+                logging.info("train: step %d eval %.6f", step_i + 1, float(val))
+            except (TypeError, ValueError):
+                logging.info("train: step %d eval (pytree)", step_i + 1)
+            if on_eval is not None:
+                on_eval(step_i + 1, val)
         if (saver is not None and is_chief and save_every
                 and (step_i + 1) % save_every == 0 and step_i + 1 < steps):
             saver.save(state, prefix_base, runner=runner)

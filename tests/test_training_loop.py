@@ -134,6 +134,24 @@ def test_dash_prefix_names_do_not_collide(tmp_path):
     assert int(resumed.step) == 5  # resumed gen-3, not gen-ema-7
 
 
+def test_eval_hook_fires_on_current_params(tmp_path):
+    """eval_every runs the forward-only evaluate on the training state; the
+    held-out loss decreases as training progresses."""
+    evals = []
+    held_out = _batch_fn(999)
+    train(_runner(), _params(), _batch_fn, steps=9, log_every=0,
+          eval_every=3, eval_batch=held_out,
+          on_eval=lambda step, val: evals.append((step, float(val))))
+    assert [s for s, _ in evals] == [3, 6, 9]
+    assert evals[-1][1] < evals[0][1]
+
+
+def test_eval_every_without_batch_raises():
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="eval_batch"):
+        train(_runner(), _params(), _batch_fn, steps=2, eval_every=1)
+
+
 def test_train_consumes_dataloader():
     """The native/fallback DataLoader's iterator plugs into train() directly
     (the host data pipeline and the loop compose)."""

@@ -60,6 +60,13 @@ def test_tuner_all_failing_raises():
                       candidates=[ExplodingBuilder()])
 
 
+def test_tuner_with_accumulation():
+    result = tune_strategy(_loss, _params(), optax.sgd(0.1), _batch(),
+                           candidates=[AllReduce()], warmup_steps=1,
+                           measure_steps=2, accumulation_steps=2)
+    assert result.results[0].steps_per_sec > 0
+
+
 def test_tuner_with_aux_loss():
     def loss_aux(p, b):
         err = b["y"] - (b["x"] @ p["w"] + p["b"])
