@@ -26,6 +26,23 @@ def num_groups(channels: int, max_groups: int) -> int:
     return g
 
 
+def fused_lm_head_nll(h, params, targets, tied: bool = False):
+    """Per-token NLL [B, T] through the fused pallas head+loss for the zoo's
+    flax LM-head convention — THE single definition of which param is the head
+    table and in which layout (untied: ``params['lm_head']['kernel']``, [D, V];
+    tied: ``params['embed']['embedding']``, [V, D]) so no model's fused loss
+    can drift from another's."""
+    from autodist_tpu.ops.fused_xent import fused_softmax_xent
+    h2 = h.reshape(-1, h.shape[-1])
+    if tied:
+        nll = fused_softmax_xent(h2, params["embed"]["embedding"],
+                                 targets.reshape(-1), w_layout="vd")
+    else:
+        nll = fused_softmax_xent(h2, params["lm_head"]["kernel"],
+                                 targets.reshape(-1))
+    return nll.reshape(targets.shape)
+
+
 def make_classification_loss_fn(model) -> Callable:
     """Softmax cross entropy over {"images", "labels"} batches (ResNet/VGG style)."""
 

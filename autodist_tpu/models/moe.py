@@ -39,6 +39,9 @@ class MoETransformerLMConfig:
     capacity_factor: float = 1.25   # capacity = ceil(tokens/expert * factor)
     router_aux_weight: float = 1e-2  # Switch load-balancing loss weight
     dtype: Any = jnp.bfloat16
+    # Fused pallas head+loss (ops/fused_xent): logits never materialize in HBM;
+    # same win as the flagship (transformer_lm.fused_head).
+    fused_head: bool = False
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -137,7 +140,7 @@ class MoETransformerLM(nn.Module):
     config: MoETransformerLMConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden=False):
         cfg = self.config
         _, length = tokens.shape
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -153,6 +156,10 @@ class MoETransformerLM(nn.Module):
             aux_total = aux_total + aux
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            # The fused-head loss owns the projection; head params exist from
+            # init (which runs the normal path below).
+            return x, aux_total / cfg.n_layers
         # Head matmul in compute dtype (the loss upcasts for the softmax) — an
         # f32 vocab projection runs at a fraction of the bf16 MXU rate.
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -167,6 +174,11 @@ def make_loss_fn(model: MoETransformerLM) -> Callable:
     def loss_fn(params, batch):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if cfg.fused_head:
+            from autodist_tpu.models.common import fused_lm_head_nll
+            h, aux = model.apply({"params": params}, inputs, return_hidden=True)
+            nll = fused_lm_head_nll(h, params, targets)
+            return nll.mean() + cfg.router_aux_weight * aux
         logits, aux = model.apply({"params": params}, inputs)
         logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]

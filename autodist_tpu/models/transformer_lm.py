@@ -168,23 +168,15 @@ class TransformerLM(nn.Module):
 
 def fused_head_nll(model: TransformerLM, params, inputs, targets,
                    pos_offset=0) -> jax.Array:
-    """Per-token NLL [B, T] through the fused pallas head+loss
-    (``ops/fused_xent``): the single definition of which param is the head
-    table and in which layout — shared by :func:`make_loss_fn` and the
-    sequence-parallel loss (``parallel/sequence.py``), so the two paths can
-    never encode different objectives."""
-    from autodist_tpu.ops.fused_xent import fused_softmax_xent
+    """Per-token NLL [B, T] through the fused pallas head+loss — shared by
+    :func:`make_loss_fn` and the sequence-parallel loss
+    (``parallel/sequence.py``). The head-param/layout contract itself lives in
+    :func:`autodist_tpu.models.common.fused_lm_head_nll` (one definition for
+    the whole zoo)."""
+    from autodist_tpu.models.common import fused_lm_head_nll
     h = model.apply({"params": params}, inputs, pos_offset=pos_offset,
                     return_hidden=True)
-    h2 = h.reshape(-1, h.shape[-1])
-    if model.config.tied_output:
-        # Tied head: the table is the [V, D] embedding itself.
-        nll = fused_softmax_xent(h2, params["embed"]["embedding"],
-                                 targets.reshape(-1), w_layout="vd")
-    else:
-        nll = fused_softmax_xent(h2, params["lm_head"]["kernel"],
-                                 targets.reshape(-1))
-    return nll.reshape(targets.shape)
+    return fused_lm_head_nll(h, params, targets, tied=model.config.tied_output)
 
 
 def make_loss_fn(model: TransformerLM) -> Callable:

@@ -27,6 +27,7 @@ def main():
 
     from autodist_tpu import AutoDist
     from autodist_tpu.models import transformer_lm
+    from autodist_tpu.ops import mosaic_compiles
     from autodist_tpu.strategy import AllReduce
 
     platform = jax.devices()[0].platform
@@ -43,7 +44,7 @@ def main():
         # it unlocks batch 384, which OOMs with materialized logits. Gated on
         # the platforms whose Mosaic backend compiles the kernels — elsewhere
         # (GPU) pallas would run in interpret mode and crater the bench.
-        fused_head=jax.default_backend() in ("tpu", "axon"))
+        fused_head=mosaic_compiles())
     # Swept on a v5e chip: fused head 384/device = ~426k tokens/s vs 410k at
     # 256 and 421k at 512; XLA head topped out at ~404k (bs 256; 384 OOMs);
     # seq512 loses (346k at 128).

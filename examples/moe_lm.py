@@ -36,12 +36,17 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     import jax
+
+    from autodist_tpu.ops import mosaic_compiles
     on_accel = jax.default_backend() != "cpu"
     cfg = moe.MoETransformerLMConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=8,
         n_layers=args.n_layers, d_ff=4 * args.d_model, max_len=args.seq_len + 1,
         n_experts=args.n_experts,
-        dtype=jnp.bfloat16 if on_accel else jnp.float32)
+        dtype=jnp.bfloat16 if on_accel else jnp.float32,
+        # Fused pallas head on Mosaic-compiling backends, like the flagship
+        # bench (elsewhere pallas would run in interpret mode).
+        fused_head=mosaic_compiles())
 
     model, params = moe.init_params(cfg)
     loss_fn = moe.make_loss_fn(model)

@@ -80,6 +80,31 @@ def test_expert_parallel_plan_shards_expert_axis():
         jax.sharding.PartitionSpec()
 
 
+def test_moe_fused_head_matches_xla_head():
+    """fused_head=True on the MoE LM equals the XLA-head loss (incl. the
+    router aux term) and trains under ExpertParallel."""
+    import dataclasses
+
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.strategy import ExpertParallel
+
+    cfg_f = dataclasses.replace(TINY, fused_head=True)
+    model, params = moe.init_params(TINY)
+    model_f = moe.MoETransformerLM(cfg_f)
+    batch = moe.synthetic_batch(TINY, batch_size=4, seq_len=16)
+    l_xla = float(moe.make_loss_fn(model)(params, batch))
+    l_fused = float(moe.make_loss_fn(model_f)(params, batch))
+    np.testing.assert_allclose(l_fused, l_xla, rtol=1e-5)
+
+    ad = AutoDist(_spec_for(), strategy_builder=ExpertParallel(num_experts=4))
+    step = ad.function(moe.make_loss_fn(model_f), params, optax.adam(1e-2),
+                       example_batch=batch)
+    losses = [float(step(batch)) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
 def test_moe_trains_expert_parallel_and_state_is_sharded():
     model, params = moe.init_params(TINY)
     loss_fn = moe.make_loss_fn(model)
