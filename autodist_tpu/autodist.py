@@ -170,7 +170,8 @@ class AutoDist:
                                    sparse_names: Optional[Sequence[str]] = None,
                                    has_aux: bool = False,
                                    num_workers: Optional[int] = None,
-                                   accumulation_steps: int = 1) -> DistributedRunner:
+                                   accumulation_steps: int = 1,
+                                   batch_size: Optional[int] = None) -> DistributedRunner:
         """Compile the strategy for this model and return the runner
         (reference autodist.py:191-198 returned the wrapped session).
 
@@ -219,7 +220,8 @@ class AutoDist:
             return runner
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
                                  has_aux=has_aux, plan=plan,
-                                 accumulation_steps=accumulation_steps)
+                                 accumulation_steps=accumulation_steps,
+                                 batch_size=batch_size)
 
     def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
         if sparse_names is not None:
@@ -231,7 +233,8 @@ class AutoDist:
     # ----------------------------------------------------------------- function
     def function(self, loss_fn: Callable, params: Any, optimizer,
                  example_batch: Any = None, sparse_names: Optional[Sequence[str]] = None,
-                 has_aux: bool = False, accumulation_steps: int = 1) -> Callable:
+                 has_aux: bool = False, accumulation_steps: int = 1,
+                 batch_size: Optional[int] = None) -> Callable:
         """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
         internally (reference autodist.py:252-289 cached a built runner the same
         way: first call builds, later calls reuse).
@@ -242,7 +245,7 @@ class AutoDist:
         in-process phantom worker that never steps would deadlock the gate)."""
         runner = self.create_distributed_session(
             loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
-            accumulation_steps=accumulation_steps)
+            accumulation_steps=accumulation_steps, batch_size=batch_size)
         state = runner.init(params)
 
         def step(batch, fetches=None):

@@ -144,11 +144,32 @@ class Saver:
         ``<save_path>-<step>.npz`` (instead of trusting the directory's shared
         ``checkpoint`` state file) keeps rotation per *name*: two models
         checkpointing into one directory under different names never adopt —
-        or delete — each other's files."""
+        or delete — each other's files.
+
+        When the state file records a rotation list for THIS name, only files in
+        it are adopted: a ``<name>-<step>.npz`` the user copied aside / renamed
+        into the directory to preserve beyond ``max_to_keep`` was never
+        rotation-managed and must not be rotate-deleted after a restart."""
         if self._rotation_loaded:
             return
         self._rotation_loaded = True
-        for _, prefix in _scan_checkpoints(save_path):
+        on_disk = [prefix for _, prefix in _scan_checkpoints(save_path)]
+        state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
+        recorded = []
+        if os.path.exists(state_path):
+            try:
+                with open(state_path) as f:
+                    recorded = json.load(f).get("all") or []
+            except (ValueError, OSError):
+                recorded = []
+        name_pat = re.compile(re.escape(save_path) + r"-\d+")
+        ours_recorded = {p for p in recorded if name_pat.fullmatch(p)}
+        if ours_recorded:
+            # A previous run of this name left its rotation list: honor it.
+            on_disk = [p for p in on_disk if p in ours_recorded]
+        # else: no state for this name (fresh dir, deleted state file, or a state
+        # file written by another name sharing the directory) — adopt the scan.
+        for prefix in on_disk:
             if prefix not in self._kept:
                 self._kept.append(prefix)
 

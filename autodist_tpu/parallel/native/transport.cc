@@ -25,6 +25,12 @@
 
 namespace {
 
+// Last failure's errno, per thread (0 = orderly EOF). The -1 return code
+// collapses all failures; Python reads this back via tr_last_errno() so a
+// native-path ConnectionError carries the same diagnostic the fallback's
+// OSError would.
+thread_local int g_last_errno = 0;
+
 uint64_t to_be64(uint64_t v) {
   const uint16_t probe = 1;
   if (*reinterpret_cast<const uint8_t*>(&probe) == 0) return v;  // big-endian
@@ -41,12 +47,16 @@ int read_exact(int fd, void* buf, size_t n, bool* started) {
   auto* p = static_cast<uint8_t*>(buf);
   while (n > 0) {
     ssize_t r = ::read(fd, p, n);
-    if (r == 0) return -1;                       // peer closed
+    if (r == 0) {                                // peer closed
+      g_last_errno = 0;
+      return -1;
+    }
     if (r < 0) {
       if (errno == EINTR) {
         if (!*started) return -2;
         continue;
       }
+      g_last_errno = errno;
       return -1;
     }
     *started = true;
@@ -79,6 +89,7 @@ int tr_send(int fd, const void* buf, uint64_t n) {
         if (!started) return -2;
         continue;
       }
+      g_last_errno = errno;
       return -1;
     }
     if (w > 0) started = true;
@@ -106,7 +117,10 @@ int64_t tr_recv(int fd, void** out) {
   if (rc != 0) return rc;
   uint64_t n = to_be64(hdr);
   void* buf = std::malloc(n ? static_cast<size_t>(n) : 1);
-  if (buf == nullptr) return -1;
+  if (buf == nullptr) {
+    g_last_errno = ENOMEM;
+    return -1;
+  }
   if (n && read_exact(fd, buf, static_cast<size_t>(n), &started) != 0) {
     std::free(buf);
     return -1;
@@ -116,5 +130,9 @@ int64_t tr_recv(int fd, void** out) {
 }
 
 void tr_free(void* p) { std::free(p); }
+
+// errno of this thread's most recent tr_send/tr_recv failure (0 = the peer
+// closed the connection in an orderly way). Valid immediately after a -1.
+int tr_last_errno() { return g_last_errno; }
 
 }  // extern "C"

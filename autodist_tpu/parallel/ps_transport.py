@@ -80,11 +80,26 @@ def _native_transport():
         lib.tr_recv.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
         lib.tr_free.restype = None
         lib.tr_free.argtypes = [ctypes.c_void_p]
+        lib.tr_last_errno.restype = ctypes.c_int
+        lib.tr_last_errno.argtypes = []
         _TR_LIB = lib
         return _TR_LIB
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
+def _native_error(lib, what: str) -> ConnectionError:
+    """ConnectionError carrying the native layer's errno (the C functions
+    collapse failures to -1; tr_last_errno() preserves the diagnostic the
+    Python fallback's OSError would have shown)."""
+    err = lib.tr_last_errno()
+    if err == 0:
+        return ConnectionError(f"PS transport {what}: connection closed by peer")
+    return ConnectionError(
+        f"PS transport {what} failed (errno {err}: {os.strerror(err)})")
+
+
+def _send_msg(sock: socket.socket, obj) -> int:
+    """Send one framed message; returns the payload byte count (for the
+    client's wire accounting)."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     # Native path only for plain blocking sockets: a socket timeout must keep
     # Python's timeout semantics, which raw-fd syscalls would bypass.
@@ -93,14 +108,15 @@ def _send_msg(sock: socket.socket, obj) -> None:
         while True:
             rc = lib.tr_send(sock.fileno(), payload, len(payload))
             if rc == 0:
-                return
+                return len(payload)
             if rc == -2:
                 # Signal before any byte moved: the ctypes-call boundary has
                 # run pending Python signal handlers (KeyboardInterrupt raises
                 # here); otherwise retry the send.
                 continue
-            raise ConnectionError("PS transport send failed")
+            raise _native_error(lib, "send")
     sock.sendall(_HDR.pack(len(payload)) + payload)
+    return len(payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -114,6 +130,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_msg(sock: socket.socket):
+    """Receive one framed message; returns ``(obj, payload_bytes)``."""
     lib = _native_transport() if sock.gettimeout() is None else None
     if lib is not None:
         import ctypes
@@ -123,15 +140,15 @@ def _recv_msg(sock: socket.socket):
             if n != -2:  # -2 = signal at a message boundary -> handlers ran; retry
                 break
         if n < 0:
-            raise ConnectionError("PS transport connection closed")
+            raise _native_error(lib, "recv")
         try:
             # Zero-copy view over the malloc'd buffer for unpickling.
             view = memoryview((ctypes.c_char * n).from_address(out.value or 0))
-            return pickle.loads(view)
+            return pickle.loads(view), n
         finally:
             lib.tr_free(out)
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    return pickle.loads(_recv_exact(sock, n)), n
 
 
 def _to_host(tree: PyTree) -> PyTree:
@@ -163,7 +180,7 @@ class PSServer:
                 self.worker_id = None
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
+                        msg, _ = _recv_msg(self.request)
                         if msg[0] in ("start_step", "finish_step"):
                             self.worker_id = msg[1]
                         _send_msg(self.request, outer._dispatch(msg))
@@ -208,6 +225,11 @@ class PSServer:
             if op == "read":
                 params, ef_state, version = r.service.read()
                 return ("ok", _to_host(params), _to_host(ef_state), version)
+            if op == "read_if_newer":
+                params, ef_state, version = r.service.read_if_newer(msg[1])
+                if params is None:  # not modified: version-only reply, no tree
+                    return ("ok", None, None, version)
+                return ("ok", _to_host(params), _to_host(ef_state), version)
             if op == "apply":
                 version = r.service.apply(msg[1])
                 return ("ok", version)
@@ -248,11 +270,16 @@ class _PSClient:
                 time.sleep(0.2)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
+        # Wire accounting (payload bytes, both directions) — lets callers and
+        # tests measure what a protocol change (e.g. read_if_newer) saves.
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     def call(self, *msg):
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            self.bytes_sent += _send_msg(self._sock, msg)
+            reply, nbytes = _recv_msg(self._sock)
+            self.bytes_received += nbytes
         if reply[0] != "ok":
             # Re-raise gate timeouts under their real type so callers written
             # against the AsyncWorker contract (`except StalenessTimeout`) keep
@@ -282,21 +309,46 @@ class RemotePSWorker:
         self.worker_id = worker_id
         self.steps_completed = 0
         self.last_version_read = -1
+        # Cache of the last pulled (params, ef_state): the conditional pull in
+        # step() reuses it when the service version is unchanged, so a worker
+        # whose gate opened with no intervening applies ships no parameter
+        # bytes (the reference's proxy-variable cache served the same purpose,
+        # proxy_variable.py:74-114).
+        self._cached_pull = None
+
+    @property
+    def wire_bytes(self) -> Tuple[int, int]:
+        """(sent, received) payload bytes over this worker's transport."""
+        return self._client.bytes_sent, self._client.bytes_received
 
     def warmup(self, batch: PyTree) -> None:
         """Compile this worker's gradient program without applying an update
         (pull params, compile, discard) — keeps process-startup compile time out
-        of the staleness-gated stepping."""
-        params, ef_state, _ = self._client.call("read")
+        of the staleness-gated stepping. The pull seeds the conditional-read
+        cache, so the first step() skips re-downloading an unchanged tree."""
+        params, ef_state, _ = self._pull()
         sharded = self._runner.shard_batch(batch)
         with self._runner.mesh:
             jax.block_until_ready(self._runner.grad_fn(params, sharded, ef_state)[0])
 
+    def _pull(self):
+        """Current (params, ef_state, version), skipping the parameter payload
+        when the service hasn't advanced past the cached version."""
+        if self._cached_pull is None:
+            params, ef_state, version = self._client.call("read")
+        else:
+            params, ef_state, version = self._client.call(
+                "read_if_newer", self.last_version_read)
+            if params is None:  # not modified: the cached tree IS current
+                params, ef_state = self._cached_pull
+        self._cached_pull = (params, ef_state)
+        self.last_version_read = version
+        return params, ef_state, version
+
     def step(self, batch: PyTree, timeout: Optional[float] = None):
         r = self._runner
         self._client.call("start_step", self.worker_id, timeout)
-        params, ef_state, version = self._client.call("read")
-        self.last_version_read = version
+        params, ef_state, _ = self._pull()
         sharded = r.shard_batch(batch)
         with r.mesh:
             grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)

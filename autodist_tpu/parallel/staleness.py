@@ -130,6 +130,20 @@ class ParameterService:
         with self._lock:
             return self._state.params, self._state.ef_state, self._version
 
+    def read_if_newer(self, version: int):
+        """Conditional :meth:`read`: ``(params, ef_state, version)`` when the
+        service has advanced past ``version``, else ``(None, None, version)``.
+        The version check and the snapshot share one lock hold, so "not
+        modified" is exact — the caller's copy at ``version`` IS the current
+        state. This is the transport's bandwidth valve (the reference's proxy
+        variables cached reads the same way, proxy_variable.py:74-114): a
+        worker whose gate opened with no intervening applies skips re-pulling
+        an identical parameter tree."""
+        with self._lock:
+            if self._version == version:
+                return None, None, self._version
+            return self._state.params, self._state.ef_state, self._version
+
     def apply(self, grads: PyTree) -> int:
         """Apply one worker's gradients; returns the new version."""
         with self._lock:

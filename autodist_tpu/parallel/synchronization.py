@@ -67,6 +67,19 @@ jax.tree_util.register_dataclass(
     PowerSGDState, data_fields=["error", "q"], meta_fields=[])
 
 
+_WARNED: set = set()
+
+
+def _warn_once(key: str, message: str) -> None:
+    """Log a knob-downgrade warning once per process (grad fns rebuild per
+    runner; the user needs the diagnostic, not a log flood)."""
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    from autodist_tpu.utils import logging
+    logging.warning(message)
+
+
 def mesh_dp_size(mesh: Mesh) -> int:
     """Actual data-parallel size of a mesh: product of the DP axes it carries.
 
@@ -156,7 +169,26 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
     """
     dp = mesh_dp_size(mesh)
     sparse_wire = sharding_plan.sparse_wire_params if dp > 1 else {}
-    use_explicit = sharding_plan.has_compression or bool(sparse_wire)
+    spec_dcn = plan_lib.strategy_pb2.AllReduceSynchronizer.DCN
+    # Two-phase reduce needs both DP axes populated (inner = intra-slice tier).
+    hierarchical_ok = all(mesh.shape.get(a, 1) > 1 for a in plan_lib.DP_AXES)
+    requested_dcn = any(p.spec == spec_dcn
+                        for p in sharding_plan.params.values())
+    # A DCN (hierarchical-reduce) request is itself a reason to take the
+    # explicit lowering: on the implicit path XLA owns the reduction schedule
+    # and the knob would silently do nothing.
+    honor_dcn = (requested_dcn and dp > 1 and hierarchical_ok
+                 and sharding_plan.all_params_replicated)
+    use_explicit = (sharding_plan.has_compression or bool(sparse_wire)
+                    or honor_dcn)
+    if requested_dcn and dp > 1 and not honor_dcn:
+        msg = ("spec=DCN (hierarchical two-phase reduce) was requested but "
+               "cannot be honored on this mesh/strategy (%s); gradients use a "
+               "single-phase reduce" % (
+                   "mesh lacks a populated inner DP axis" if not hierarchical_ok
+                   else "partitioned parameters use the implicit SPMD lowering"))
+        _warn_once(msg, msg)  # keyed by the full message: distinct downgrade
+        # reasons in one process each get their own diagnostic
 
     def implicit(params, batch, ef_state):
         if has_aux:
@@ -184,9 +216,6 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
 
     from autodist_tpu.model_spec import _path_name as name_of
     plans_by_name = dict(sharding_plan.params)
-    spec_dcn = plan_lib.strategy_pb2.AllReduceSynchronizer.DCN
-    # Two-phase reduce needs both DP axes populated (inner = intra-slice tier).
-    hierarchical_ok = all(mesh.shape.get(a, 1) > 1 for a in plan_lib.DP_AXES)
 
     def _pmean(x, spec: int):
         """Cross-replica mean, honoring the network-tier knob: DCN requests a

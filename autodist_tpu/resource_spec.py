@@ -118,6 +118,10 @@ class Node:
         self.address: str = str(entry["address"])
         self.chief: bool = bool(entry.get("chief", False))
         self.ssh_config_name: Optional[str] = entry.get("ssh_config")
+        # Whether the spec stated a bandwidth (vs the 1 GBE default): consumers
+        # making numerics-affecting choices (AutoStrategy's lossy wire codecs)
+        # must not treat the defaulted value as a measurement.
+        self.bandwidth_specified: bool = "network_bandwidth" in entry
         self.network_bandwidth: int = int(
             entry.get("network_bandwidth", DEFAULT_NETWORK_BANDWIDTH_GBPS))
         if self.network_bandwidth <= 0:

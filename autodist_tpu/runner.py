@@ -102,9 +102,12 @@ class DistributedRunner:
     def __init__(self, compiled_strategy, model_spec: ModelSpec, loss_fn: Callable,
                  optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
                  donate_state: bool = True, plan: Optional[ShardingPlan] = None,
-                 accumulation_steps: int = 1):
+                 accumulation_steps: int = 1, batch_size: Optional[int] = None):
         if accumulation_steps < 1:
             raise ValueError("accumulation_steps must be >= 1")
+        # Explicit global batch size for micro-batch splitting; when None it is
+        # inferred per batch as the modal leading dim (see shard_batch).
+        self._batch_size = batch_size
         self._model_spec = model_spec
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -265,6 +268,47 @@ class DistributedRunner:
                 "(each new identity recompiles the whole training step)")
         return jitted
 
+    def _infer_batch_dim(self, batch: PyTree, split: int) -> int:
+        """The global batch size for micro-splitting: the explicit ``batch_size``
+        if the runner was given one, else the unique splittable leading dim.
+
+        There is no structural rule that can tell a batch leaf from an
+        auxiliary leaf that happens to be splittable (sampled-softmax negatives
+        longer than the batch, per-class vectors shorter than it — either can
+        outnumber or outweigh the true batch leaves), and guessing wrong
+        silently changes the loss. So: exactly one splittable dim -> use it;
+        more than one -> refuse and ask for ``batch_size=``."""
+        if self._batch_size is not None:
+            return self._batch_size
+        from collections import Counter
+        dims: Counter = Counter()
+        for leaf in jax.tree_util.tree_leaves(batch, is_leaf=_is_micro):
+            if _is_micro(leaf):
+                # Already laid out [k, B/k, ...] by a previous shard_batch.
+                dims[leaf.value.shape[0] * leaf.value.shape[1]] += 1
+                continue
+            shape = getattr(leaf, "shape", None)
+            if shape is None:
+                shape = np.asarray(leaf).shape
+            if len(shape) >= 1:
+                dims[shape[0]] += 1
+        if not dims:
+            return 0
+        splittable = sorted(d for d in dims if d % split == 0)
+        if len(splittable) == 1:
+            return splittable[0]
+        if len(splittable) > 1:
+            raise ValueError(
+                f"Ambiguous batch dimension for gradient accumulation: leading "
+                f"dims {splittable} are all divisible by accumulation_steps*dp="
+                f"{split}, and micro-splitting the wrong one would silently "
+                f"change the loss; pass batch_size= to the runner (or "
+                f"AutoDist.function / create_distributed_session) to pick one")
+        # Nothing splittable: report against the most common leading dim (the
+        # likeliest batch) so the divisibility error below names it.
+        top = max(dims.values())
+        return max(d for d, c in dims.items() if c == top)
+
     def shard_batch(self, batch: PyTree,
                     accumulation: Optional[int] = None) -> PyTree:
         """Feed remapping: split batch leaves across data replicas, duplicate the
@@ -281,18 +325,34 @@ class DistributedRunner:
         k = self._accum if accumulation is None else accumulation
 
         # Which leaves are *batch* leaves for micro-splitting: those whose leading
-        # dim equals the global batch size, taken as the largest leading dim in the
-        # pytree. Auxiliary leaves (per-class weights, small constants) keep the
-        # plain accum=1 placement — splitting them into micro-slices would change
-        # the values the loss function sees.
+        # dim equals the global batch size. The batch size is the modal (most
+        # common) leading dim across the pytree, not the largest — an auxiliary
+        # leaf longer than the batch (e.g. sampled-softmax negatives with
+        # num_sampled > batch_size) must NOT be mistaken for the batch, or each
+        # micro-step would see the full batch with a slice of the negatives.
+        # Ambiguity (two splittable dims equally common) raises rather than
+        # guessing; ``batch_size=`` on the runner resolves it explicitly.
         batch_dim = 0
         if k > 1:
+            batch_dim = self._infer_batch_dim(batch, k * dp)
+            leading = set()
             for leaf in jax.tree_util.tree_leaves(batch, is_leaf=_is_micro):
                 if _is_micro(leaf):
-                    continue
-                shape = getattr(leaf, "shape", None) or np.asarray(leaf).shape
-                if len(shape) >= 1:
-                    batch_dim = max(batch_dim, shape[0])
+                    leading.add(leaf.value.shape[0] * leaf.value.shape[1])
+                else:
+                    shape = getattr(leaf, "shape", None)
+                    if shape is None:
+                        shape = np.asarray(leaf).shape
+                    if len(shape) >= 1:
+                        leading.add(shape[0])
+            if batch_dim not in leading:
+                # A typo'd explicit batch_size would otherwise silently disable
+                # micro-splitting while the accumulation scan still runs k
+                # identical full-batch micro-steps.
+                raise ValueError(
+                    f"batch_size={batch_dim} matches no leaf's leading dim "
+                    f"(present: {sorted(leading)}); nothing would be "
+                    f"micro-split for accumulation_steps={k}")
 
         def put(leaf):
             if _is_micro(leaf):

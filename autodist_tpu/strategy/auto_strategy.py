@@ -74,10 +74,23 @@ class AutoStrategy(StrategyBuilder):
 
     # ------------------------------------------------------------------ model
     def _pick_codec(self, resource_spec: ResourceSpec):
-        """(spec, compressor) for AllReduce nodes, from the slowest network tier."""
+        """(spec, compressor) for AllReduce nodes, from the slowest network tier.
+
+        Lossy codecs (bf16 / error feedback) change numerics, so they are only
+        chosen from bandwidth the user actually stated: a spec that leaves
+        ``network_bandwidth`` unset gets the lossless hierarchical reduce, not a
+        compression decision inferred from the YAML parser's 1 GBE default."""
         AR = strategy_pb2.AllReduceSynchronizer
         if resource_spec.num_nodes <= 1:
             return AR.AUTO, AR.NONE, "single node: ICI, dense bf16-free wire"
+        if not all(n.bandwidth_specified for n in resource_spec.nodes):
+            logging.warning(
+                "AutoStrategy: multi-node spec without explicit network_bandwidth;"
+                " keeping the lossless wire (set network_bandwidth per node to "
+                "enable bf16/error-feedback compression)")
+            return AR.DCN, AR.NONE, (
+                "multi-node, bandwidth unspecified: hierarchical DCN reduce, "
+                "lossless wire (declare network_bandwidth to opt into bf16/EF)")
         slowest = min(n.network_bandwidth for n in resource_spec.nodes)
         if slowest <= self._ef_gbps:
             return AR.DCN, AR.BF16_EF, (
@@ -113,12 +126,41 @@ class AutoStrategy(StrategyBuilder):
             model_axis = next((d for d in divisors if d >= need),
                               divisors[-1] if divisors else 1)
 
+        ar_spec, ar_compressor, codec_reason = self._pick_codec(resource_spec)
         axes = dict(PS_DEFAULT_AXES if memory_bound else AR_DEFAULT_AXES)
+        if (not memory_bound
+                and ar_spec == strategy_pb2.AllReduceSynchronizer.DCN):
+            # The DCN knob requests a two-phase reduce, which needs BOTH data-
+            # parallel mesh axes populated (inner = intra-node ICI tier). Carve
+            # the inner axis from the per-node chip count so the knob this
+            # builder emits is actually honored by the lowering, instead of
+            # silently collapsing to a single-phase reduce on {data: -1}.
+            counts = [max(1, len(n.accelerator_devices))
+                      for n in resource_spec.nodes]
+            inner = counts[0] if len(set(counts)) == 1 else 0
+            if model_axis > 1:
+                # Partitioned parameters take the implicit SPMD lowering, where
+                # XLA owns the reduction schedule — the two-phase knob cannot
+                # be honored there, so say so rather than pretending.
+                logging.warning(
+                    "AutoStrategy: hierarchical DCN reduce downgraded — "
+                    "partitioned parameters use the implicit lowering (XLA "
+                    "schedules the cross-node reduction)")
+            elif inner > 1 and n_dev % inner == 0:
+                axes = {const.MESH_AXIS_REDUCE: inner,
+                        const.MESH_AXIS_DATA: -1}
+                self._decisions.append(
+                    ("<mesh>", f"DCN hierarchical reduce: inner ICI axis = "
+                               f"{inner} chips/node x {n_dev // inner} nodes"))
+            else:
+                logging.warning(
+                    "AutoStrategy: hierarchical DCN reduce downgraded to a "
+                    "single-phase reduce — per-node chip counts %s do not form "
+                    "an even inner mesh axis", counts)
         if model_axis > 1:
             axes[const.MESH_AXIS_MODEL] = model_axis
         resolved = self._resolved_axes(resource_spec, axes)
         n_dest = resolved.get(const.MESH_AXIS_REDUCE, 1)
-        ar_spec, ar_compressor, codec_reason = self._pick_codec(resource_spec)
 
         self._decisions.append(
             ("<regime>",

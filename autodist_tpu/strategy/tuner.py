@@ -25,14 +25,16 @@ from autodist_tpu.utils import logging
 class CandidateResult:
     builder: StrategyBuilder
     name: str
-    steps_per_sec: Optional[float]    # None = failed
+    steps_per_sec: Optional[float]    # None = failed or skipped
     error: Optional[str] = None
+    accumulation_steps: int = 1
 
 
 @dataclasses.dataclass
 class TuneResult:
     best: StrategyBuilder
     results: List[CandidateResult]
+    best_accumulation_steps: int = 1
 
     def report(self) -> str:
         """Human-readable ranking table."""
@@ -42,9 +44,14 @@ class TuneResult:
         lines = []
         for r in rows:
             if r.steps_per_sec is None:
-                lines.append(f"{r.name:<{width}}  FAILED: {r.error}")
+                label = "SKIPPED" if (r.error or "").startswith("skipped") \
+                    else "FAILED"
+                lines.append(f"{r.name:<{width}}  {label}: {r.error}")
             else:
-                marker = "  <- best" if r.builder is self.best else ""
+                marker = "  <- best" if (
+                    r.builder is self.best
+                    and r.accumulation_steps == self.best_accumulation_steps) \
+                    else ""
                 lines.append(f"{r.name:<{width}}  {r.steps_per_sec:8.2f} steps/s"
                              f"{marker}")
         return "\n".join(lines)
@@ -66,13 +73,27 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                   warmup_steps: int = 2, measure_steps: int = 8,
                   sparse_names: Optional[Sequence[str]] = None,
                   has_aux: bool = False,
-                  accumulation_steps: int = 1) -> TuneResult:
+                  accumulation_steps=1) -> TuneResult:
     """Measure each candidate builder on the real (model, batch, devices).
 
     Returns the fastest builder plus the full ranking; pass ``result.best`` to
     :class:`AutoDist`. Each candidate gets ``warmup_steps`` (compile + first
     dispatch) then ``measure_steps`` timed steps, fenced by a host read of the
     loss. State and compiled executables are dropped between candidates.
+
+    **Ranking is synchronous and local.** Every candidate is stepped on this
+    process's devices through the synchronous SPMD runner, so rankings are
+    comparable only within that regime: a multi-node ``resource_spec`` is
+    rejected (the local measurement would say nothing about cross-node wire
+    cost — benchmark those through a real cluster launch), and an async
+    candidate (``sync=False`` / ``staleness>0``) is recorded as skipped rather
+    than measured (its wall-clock is gate-dominated and not comparable to a
+    synchronous step).
+
+    ``accumulation_steps`` may be a single int or a sequence to sweep: each
+    candidate is measured at each value (examples/sec comparable because the
+    global batch is fixed); ``result.best_accumulation_steps`` carries the
+    winner's setting.
     """
     from autodist_tpu.autodist import (AutoDist, get_default_autodist,
                                        set_default_autodist)
@@ -83,6 +104,17 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                          "compiled, pipeline-fenced step to start from)")
     if measure_steps < 1:
         raise ValueError("measure_steps must be >= 1")
+    if resource_spec is not None and resource_spec.num_nodes > 1:
+        raise ValueError(
+            "tune_strategy measures candidates synchronously on THIS process's "
+            "local devices; a multi-node resource spec would be ranked by a "
+            "measurement that ignores the cross-node wire. Tune with a "
+            "single-node spec, or benchmark multi-node candidates through a "
+            "real cluster launch (examples/benchmark)")
+    accum_sweep = ([accumulation_steps] if isinstance(accumulation_steps, int)
+                   else list(accumulation_steps))
+    if not accum_sweep or any(a < 1 for a in accum_sweep):
+        raise ValueError("accumulation_steps must be >= 1 (int or sequence)")
     if candidates is None:
         spec = (ModelSpec(params, sparse_names=sparse_names)
                 if sparse_names is not None
@@ -93,15 +125,29 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
     prior_default = get_default_autodist()  # candidates must not leak as default
     results: List[CandidateResult] = []
     try:
-        for builder in candidates:
+        for builder, accum in ((b, a) for b in candidates for a in accum_sweep):
             name = type(builder).__name__
+            if len(accum_sweep) > 1:
+                name = f"{name}[accum={accum}]"
             ad = None
             try:
                 ad = AutoDist(resource_spec, builder)
                 runner = ad.create_distributed_session(
                     loss_fn, params, optimizer, example_batch=example_batch,
                     sparse_names=sparse_names, has_aux=has_aux,
-                    accumulation_steps=accumulation_steps)
+                    accumulation_steps=accum)
+                from autodist_tpu.parallel.staleness import AsyncPSRunner
+                if isinstance(runner, AsyncPSRunner):
+                    # Gate-dominated wall-clock is not comparable to a sync
+                    # step; record the skip instead of a misleading rate.
+                    results.append(CandidateResult(
+                        builder, name, None,
+                        "skipped: async candidate (sync=False / staleness>0) — "
+                        "tune_strategy ranks synchronous strategies only",
+                        accumulation_steps=accum))
+                    logging.warning("tune_strategy %s: skipped (async regime)",
+                                    name)
+                    continue
                 state = runner.init(params)
                 # Pre-place the batch: run()'s resident-array check then makes the
                 # per-step shard a no-op, so the timed loop measures the strategy,
@@ -117,11 +163,13 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                 loss = fetched[0] if has_aux else fetched
                 float(loss)  # completion fence (device->host read)
                 rate = measure_steps / (time.perf_counter() - t0)
-                results.append(CandidateResult(builder, name, rate))
+                results.append(CandidateResult(builder, name, rate,
+                                               accumulation_steps=accum))
                 logging.info("tune_strategy %s: %.2f steps/s", name, rate)
             except Exception as e:  # noqa: BLE001 — a candidate OOMing must not abort
                 results.append(
-                    CandidateResult(builder, name, None, f"{type(e).__name__}: {e}"))
+                    CandidateResult(builder, name, None, f"{type(e).__name__}: {e}",
+                                    accumulation_steps=accum))
                 logging.warning("tune_strategy %s failed: %s", name, e)
             finally:
                 # Tear down anything the candidate launched (clusters, PS
@@ -140,9 +188,10 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
     ranked = [r for r in results if r.steps_per_sec is not None]
     if not ranked:
         raise RuntimeError(
-            "tune_strategy: every candidate failed:\n" +
+            "tune_strategy: every candidate failed or was skipped:\n" +
             "\n".join(f"  {r.name}: {r.error}" for r in results))
     best = max(ranked, key=lambda r: r.steps_per_sec)
     logging.info("tune_strategy winner: %s (%.2f steps/s)", best.name,
                  best.steps_per_sec)
-    return TuneResult(best=best.builder, results=results)
+    return TuneResult(best=best.builder, results=results,
+                      best_accumulation_steps=best.accumulation_steps)

@@ -206,3 +206,52 @@ def test_rotation_survives_restart(tmp_path):
     s2.save(params, str(tmp_path / "ck"), global_step=3)
     assert sorted(glob.glob(str(tmp_path / "ck-*.npz"))) == [
         str(tmp_path / "ck-2.npz"), str(tmp_path / "ck-3.npz")]
+
+
+def test_user_preserved_checkpoint_survives_restart_rotation(tmp_path):
+    """A matching-name file the user copied into the directory to keep (never
+    recorded in the rotation list) must not be rotate-deleted after a restart;
+    only the recorded checkpoints rotate."""
+    import glob
+    import shutil
+
+    import numpy as np
+
+    from autodist_tpu.checkpoint import Saver
+
+    params = {"w": np.ones((2,), np.float32)}
+    s1 = Saver(max_to_keep=2)
+    for step in range(3):
+        s1.save(params, str(tmp_path / "ck"), global_step=step)
+    # User deliberately preserves step 1 beyond rotation under the same pattern.
+    shutil.copy(str(tmp_path / "ck-1.npz"), str(tmp_path / "ck-100.npz"))
+
+    s2 = Saver(max_to_keep=2)  # restart: adopts only the RECORDED rotation list
+    for step in (3, 4, 5):
+        s2.save(params, str(tmp_path / "ck"), global_step=step)
+    remaining = sorted(glob.glob(str(tmp_path / "ck-*.npz")))
+    assert str(tmp_path / "ck-100.npz") in remaining
+    assert remaining == [str(tmp_path / "ck-100.npz"),
+                         str(tmp_path / "ck-4.npz"), str(tmp_path / "ck-5.npz")]
+
+
+def test_fresh_directory_without_state_file_still_adopts(tmp_path):
+    """No state file (e.g. deleted, or checkpoints rsynced in): fall back to
+    adopting the on-disk scan so rotation still bounds disk use."""
+    import glob
+    import os
+
+    import numpy as np
+
+    from autodist_tpu.checkpoint import Saver
+
+    params = {"w": np.ones((2,), np.float32)}
+    s1 = Saver(max_to_keep=2)
+    for step in range(3):
+        s1.save(params, str(tmp_path / "ck"), global_step=step)
+    os.remove(str(tmp_path / "checkpoint"))
+
+    s2 = Saver(max_to_keep=2)
+    s2.save(params, str(tmp_path / "ck"), global_step=3)
+    assert sorted(glob.glob(str(tmp_path / "ck-*.npz"))) == [
+        str(tmp_path / "ck-2.npz"), str(tmp_path / "ck-3.npz")]

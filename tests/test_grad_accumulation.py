@@ -196,6 +196,69 @@ def test_vector_aux_averages_not_concats():
     np.testing.assert_allclose(a4[0], a1[0], rtol=2e-6, atol=2e-6)
 
 
+def test_longer_aux_leaf_is_not_mistaken_for_batch():
+    """A sampled-softmax-style auxiliary leaf LONGER than the batch (and itself
+    divisible by accum*dp) must never be silently micro-split in place of the
+    true batch: two splittable dims is an explicit ambiguity error, and
+    batch_size= resolves it to a value-exact accumulation (the long leaf stays
+    whole in every micro-step)."""
+    rng = np.random.RandomState(11)
+    neg = rng.randn(64, 1).astype(np.float32)  # longer than BATCH=32
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"] + p["b"]
+        # Every example is scored against ALL negatives every micro-step; if
+        # b["neg"] were micro-sliced the penalty term would change value.
+        penalty = jnp.mean((pred[:, None, :] - b["neg"][None, :, :]) ** 2)
+        return jnp.mean((b["y"] - pred) ** 2) + 0.1 * penalty
+
+    def run(accum, batch_size=None):
+        ad = AutoDist(strategy_builder=AllReduce())
+        batch = dict(_dense_data(), neg=neg)
+        runner = ad.create_distributed_session(
+            loss_fn, _dense_params(), optax.sgd(0.05), example_batch=batch,
+            accumulation_steps=accum, batch_size=batch_size)
+        state = runner.init(_dense_params())
+        state, loss = runner.run(state, batch)
+        return float(loss), jax.device_get(runner.logical_params(state))
+
+    with pytest.raises(ValueError, match="[Aa]mbiguous"):
+        run(2)  # both 32 and 64 are splittable: refuse to guess
+
+    (l1, p1), (l2, p2) = run(1, batch_size=BATCH), run(2, batch_size=BATCH)
+    assert l1 == pytest.approx(l2, rel=1e-6)
+    for k in p1:
+        np.testing.assert_allclose(p2[k], p1[k], rtol=2e-6, atol=2e-6)
+
+
+def test_ambiguous_batch_dim_raises_and_batch_size_resolves():
+    """Two equally-common, equally-splittable leading dims: refuse to guess;
+    an explicit batch_size= disambiguates."""
+    rng = np.random.RandomState(5)
+    batch = {"x": rng.randn(BATCH, 4).astype(np.float32),
+             "neg": rng.randn(2 * BATCH, 4).astype(np.float32)}
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"] + p["b"]
+        return jnp.mean(pred ** 2) + jnp.mean((b["neg"] @ p["w"]) ** 2)
+
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(
+        loss_fn, _dense_params(), optax.sgd(0.05), example_batch=batch,
+        accumulation_steps=2)
+    state = runner.init(_dense_params())
+    with pytest.raises(ValueError, match="[Aa]mbiguous"):
+        runner.run(state, batch)
+
+    ad2 = AutoDist(strategy_builder=AllReduce())
+    runner2 = ad2.create_distributed_session(
+        loss_fn, _dense_params(), optax.sgd(0.05), example_batch=batch,
+        accumulation_steps=2, batch_size=BATCH)
+    state2 = runner2.init(_dense_params())
+    state2, loss = runner2.run(state2, batch)
+    assert np.isfinite(float(loss))
+
+
 def test_indivisible_batch_raises():
     ad = AutoDist(strategy_builder=AllReduce())
     runner = ad.create_distributed_session(

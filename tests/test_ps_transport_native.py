@@ -78,7 +78,8 @@ def test_python_fallback_roundtrip():
                                      "python->native"])
 def test_native_and_mixed_roundtrips(pairing):
     send_fn = tp._send_msg if pairing != "python->native" else _python_send
-    recv_fn = tp._recv_msg if pairing != "native->python" else _python_recv
+    recv_fn = (lambda s: tp._recv_msg(s)[0]) if pairing != "native->python" \
+        else _python_recv
     # _send_msg/_recv_msg route to the native lib (sockets are blocking here).
     _check(_roundtrip(send_fn, recv_fn))
 
@@ -91,8 +92,8 @@ def test_timeout_sockets_use_python_path():
     a, b = socket.socketpair()
     try:
         b.settimeout(30.0)
-        tp._send_msg(a, {"x": 1})          # native (blocking side)
-        assert tp._recv_msg(b) == {"x": 1}  # python (timeout side)
+        tp._send_msg(a, {"x": 1})              # native (blocking side)
+        assert tp._recv_msg(b)[0] == {"x": 1}  # python (timeout side)
         with pytest.raises(socket.timeout):
             b.settimeout(0.2)
             tp._recv_msg(b)

@@ -86,6 +86,39 @@ def test_tuner_restores_default_autodist():
     assert get_default_autodist() is mine
 
 
+def test_tuner_rejects_multinode_spec():
+    """Ranking is sync-local: a multi-node spec must be rejected up front, not
+    silently measured on local devices."""
+    spec = ResourceSpec(
+        "nodes: [{address: 10.0.0.1, tpus: 4, chief: true}, "
+        "{address: 10.0.0.2, tpus: 4}]")
+    with pytest.raises(ValueError, match="multi-node"):
+        tune_strategy(_loss, _params(), optax.sgd(0.1), _batch(),
+                      candidates=[AllReduce()], resource_spec=spec)
+
+
+def test_tuner_skips_async_candidate():
+    """An async candidate is recorded as skipped (gate-dominated wall-clock is
+    not comparable to a sync step), and a sync candidate still wins."""
+    from autodist_tpu.strategy import PS
+    result = tune_strategy(_loss, _params(), optax.sgd(0.1), _batch(),
+                           candidates=[PS(sync=False), AllReduce()],
+                           warmup_steps=1, measure_steps=2)
+    skipped = [r for r in result.results if r.steps_per_sec is None]
+    assert len(skipped) == 1 and "async" in skipped[0].error
+    assert type(result.best).__name__ == "AllReduce"
+
+
+def test_tuner_sweeps_accumulation_steps():
+    result = tune_strategy(_loss, _params(), optax.sgd(0.1), _batch(),
+                           candidates=[AllReduce()], warmup_steps=1,
+                           measure_steps=2, accumulation_steps=[1, 2])
+    names = {r.name for r in result.results}
+    assert names == {"AllReduce[accum=1]", "AllReduce[accum=2]"}
+    assert result.best_accumulation_steps in (1, 2)
+    assert "<- best" in result.report()
+
+
 def test_tuner_rejects_zero_warmup():
     with pytest.raises(ValueError, match="warmup_steps"):
         tune_strategy(_loss, _params(), optax.sgd(0.1), _batch(),
