@@ -107,13 +107,21 @@ class ParameterService:
         self._state = state
         self._apply_fn = apply_fn
         self._lock = threading.Lock()
+        # Generation counter: bumps on EVERY state replacement (apply, reset,
+        # adopt) and is never reused, so version equality implies state
+        # identity — the contract read_if_newer's "not modified" answer (and
+        # any transport-side cache built on it) depends on. The applied-update
+        # count is tracked separately for the adopt() guard.
         self._version = 0
+        self._updates = 0
 
     def reset(self, state: TrainState):
-        """Replace the state (checkpoint restore). Version restarts at 0."""
+        """Replace the state (checkpoint restore). The update count restarts;
+        the version keeps counting so stale cached pulls can never alias."""
         with self._lock:
             self._state = state
-            self._version = 0
+            self._version += 1
+            self._updates = 0
 
     @property
     def version(self) -> int:
@@ -149,7 +157,12 @@ class ParameterService:
         with self._lock:
             self._state = self._apply_fn(self._state, grads)
             self._version += 1
+            self._updates += 1
             return self._version
+
+    @property
+    def updates_applied(self) -> int:
+        return self._updates
 
     def adopt(self, state: TrainState, place_fn) -> None:
         """Atomically adopt a foreign state iff no updates have been applied yet
@@ -159,13 +172,13 @@ class ParameterService:
         with self._lock:
             if state is self._state:
                 return
-            if self._version != 0:
+            if self._updates != 0:
                 raise RuntimeError(
                     "AsyncPSRunner.run was handed a state that is not the service's "
                     "current state after updates were already applied; use "
                     "restore(state) to adopt a checkpoint explicitly")
             self._state = place_fn(state)
-            self._version = 0
+            self._version += 1  # new generation: cached pulls must refetch
 
 
 class AsyncWorker:

@@ -268,18 +268,11 @@ class DistributedRunner:
                 "(each new identity recompiles the whole training step)")
         return jitted
 
-    def _infer_batch_dim(self, batch: PyTree, split: int) -> int:
-        """The global batch size for micro-splitting: the explicit ``batch_size``
-        if the runner was given one, else the unique splittable leading dim.
-
-        There is no structural rule that can tell a batch leaf from an
-        auxiliary leaf that happens to be splittable (sampled-softmax negatives
-        longer than the batch, per-class vectors shorter than it — either can
-        outnumber or outweigh the true batch leaves), and guessing wrong
-        silently changes the loss. So: exactly one splittable dim -> use it;
-        more than one -> refuse and ask for ``batch_size=``."""
-        if self._batch_size is not None:
-            return self._batch_size
+    def _leading_dims(self, batch: PyTree):
+        """Counter of leading dims over the batch's array leaves (MicroBatched
+        leaves count at their logical ``k * micro`` size). The single
+        shape-extraction rule shared by batch-dim inference and the explicit-
+        batch_size sanity check, so the two cannot drift apart."""
         from collections import Counter
         dims: Counter = Counter()
         for leaf in jax.tree_util.tree_leaves(batch, is_leaf=_is_micro):
@@ -292,10 +285,28 @@ class DistributedRunner:
                 shape = np.asarray(leaf).shape
             if len(shape) >= 1:
                 dims[shape[0]] += 1
+        return dims
+
+    def _infer_batch_dim(self, dims, split: int) -> int:
+        """The global batch size for micro-splitting: the explicit ``batch_size``
+        if the runner was given one, else the unique splittable leading dim —
+        provided it is also the most common one (the likeliest batch).
+
+        There is no structural rule that can tell a batch leaf from an
+        auxiliary leaf that happens to be splittable (sampled-softmax negatives
+        longer than the batch, per-class vectors shorter than it — either can
+        outnumber or outweigh the true batch leaves), and guessing wrong
+        silently changes the loss. So anything other than the clean case — one
+        splittable dim, and it is the modal one — refuses and asks for
+        ``batch_size=``."""
+        if self._batch_size is not None:
+            return self._batch_size
         if not dims:
             return 0
+        top = max(dims.values())
+        modal = {d for d, c in dims.items() if c == top}
         splittable = sorted(d for d in dims if d % split == 0)
-        if len(splittable) == 1:
+        if len(splittable) == 1 and modal == {splittable[0]}:
             return splittable[0]
         if len(splittable) > 1:
             raise ValueError(
@@ -304,10 +315,19 @@ class DistributedRunner:
                 f"{split}, and micro-splitting the wrong one would silently "
                 f"change the loss; pass batch_size= to the runner (or "
                 f"AutoDist.function / create_distributed_session) to pick one")
+        if len(splittable) == 1:
+            # The one splittable dim is NOT the most common leading dim: the
+            # likeliest batch was excluded only by divisibility. Micro-splitting
+            # the outlier would silently change the loss; make the user decide.
+            raise ValueError(
+                f"Cannot infer the batch dimension for gradient accumulation: "
+                f"the only leading dim divisible by accumulation_steps*dp="
+                f"{split} is {splittable[0]}, but the most common leading dim "
+                f"is {sorted(modal)}; pass batch_size= (or make the batch "
+                f"divisible) to pick one")
         # Nothing splittable: report against the most common leading dim (the
         # likeliest batch) so the divisibility error below names it.
-        top = max(dims.values())
-        return max(d for d, c in dims.items() if c == top)
+        return max(modal)
 
     def shard_batch(self, batch: PyTree,
                     accumulation: Optional[int] = None) -> PyTree:
@@ -334,24 +354,15 @@ class DistributedRunner:
         # guessing; ``batch_size=`` on the runner resolves it explicitly.
         batch_dim = 0
         if k > 1:
-            batch_dim = self._infer_batch_dim(batch, k * dp)
-            leading = set()
-            for leaf in jax.tree_util.tree_leaves(batch, is_leaf=_is_micro):
-                if _is_micro(leaf):
-                    leading.add(leaf.value.shape[0] * leaf.value.shape[1])
-                else:
-                    shape = getattr(leaf, "shape", None)
-                    if shape is None:
-                        shape = np.asarray(leaf).shape
-                    if len(shape) >= 1:
-                        leading.add(shape[0])
-            if batch_dim not in leading:
+            dims = self._leading_dims(batch)
+            batch_dim = self._infer_batch_dim(dims, k * dp)
+            if batch_dim not in dims:
                 # A typo'd explicit batch_size would otherwise silently disable
                 # micro-splitting while the accumulation scan still runs k
                 # identical full-batch micro-steps.
                 raise ValueError(
                     f"batch_size={batch_dim} matches no leaf's leading dim "
-                    f"(present: {sorted(leading)}); nothing would be "
+                    f"(present: {sorted(dims)}); nothing would be "
                     f"micro-split for accumulation_steps={k}")
 
         def put(leaf):

@@ -86,7 +86,8 @@ ROLE_ENV_VARS = ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID", "AUTODIST_PROCESS_ID
 
 
 def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300,
-                          attempts: int = 3, script: str = None):
+                          attempts: int = 3, script: str = None,
+                          extra_args=()):
     """Launch this script as the chief subprocess on a fresh port; the Coordinator
     inside it re-launches the worker. Shared by ``tests/test_multiprocess.py`` and
     ``__graft_entry__._dryrun_multiprocess`` so the env construction (clean role
@@ -118,7 +119,8 @@ def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300,
         env["AUTODIST_COORDINATOR_PORT"] = str(s.getsockname()[1])
         s.close()
         proc = subprocess.run(
-            [sys.executable, script or os.path.abspath(__file__), str(out_path)],
+            [sys.executable, script or os.path.abspath(__file__), str(out_path),
+             *extra_args],
             env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
         port_lost = proc.returncode != 0 and (
             "address already in use" in proc.stderr.lower()

@@ -1,0 +1,182 @@
+"""Cross-process strategy-matrix script (driver in test_multiprocess.py).
+
+The reference's 2-machine CI stage ran its full strategy dict across nodes
+(``tests/integration/test_dist.py:14-42``, ``Jenkinsfile:91-131``). This script
+is the TPU-native equivalent for the lowerings whose cross-process sharding is
+non-trivial:
+
+- ``ps``          — PS/ZeRO: Adam opt state physically sharded along ``reduce``
+                    across the 2-process mesh.
+- ``partitioned`` — UnevenPartitionedPS: model-axis storage including a
+                    padded-uneven parameter (7 rows on a 2-way model axis).
+- ``parallax``    — the explicit ``shard_map`` lowering: sparse (indices, rows)
+                    wire for the embedding + BF16_EF compressed dense params.
+
+Each config runs 3 steps through the public API. Two modes, selected by env
+``AUTODIST_MATRIX_SINGLE``:
+
+- unset: 2-process mode — the chief runs this script, the Coordinator
+  re-executes it as the worker, both join one ``jax.distributed`` program over
+  a 4-device (2 proc x 2 CPU devices) mesh.
+- "1": single-process reference — same strategy on a 4-device single-process
+  mesh. Identical global mesh => identical shard count => identical collective
+  and bf16-rounding behavior, so the 2-process run must match value-exactly.
+
+The chief writes final logical params, per-step losses, and physical-sharding
+evidence (shard shapes, padded storage shapes, sparse-wire/EF flags) to the
+JSON path in argv[1]; argv[2] picks the config.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist  # noqa: E402
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
+from autodist_tpu.strategy import PS, Parallax, UnevenPartitionedPS  # noqa: E402
+
+BATCH = 16
+LR = 0.05
+STEPS = 3
+VOCAB, DIM = 33, 4
+
+SINGLE = os.environ.get("AUTODIST_MATRIX_SINGLE") == "1"
+
+
+def _spec(mesh=None):
+    if SINGLE:
+        nodes = [{"address": "localhost", "tpus": 4, "chief": True}]
+    else:
+        nodes = [{"address": "localhost", "tpus": 2, "chief": True},
+                 {"address": "127.0.0.1", "tpus": 2}]
+    info = {"nodes": nodes}
+    if mesh:
+        info["mesh"] = mesh
+    return ResourceSpec(resource_info=info)
+
+
+def make_batch(step: int):
+    rng = np.random.RandomState(2000 + step)
+    return {"idx": rng.randint(0, VOCAB, (BATCH,)),
+            "x": rng.randn(BATCH, 7).astype(np.float32),
+            "y": rng.randn(BATCH, DIM).astype(np.float32)}
+
+
+def make_params():
+    rng = np.random.RandomState(5)
+    return {"emb": rng.randn(VOCAB, DIM).astype(np.float32) * 0.1,
+            "wu": rng.randn(7, DIM).astype(np.float32) * 0.1,   # uneven dim0
+            "w2": rng.randn(DIM, DIM).astype(np.float32) * 0.1,
+            "b": np.zeros((DIM,), np.float32)}
+
+
+def loss_fn(p, b):
+    rows = jnp.take(p["emb"], b["idx"], axis=0)        # sparse gather
+    h = rows + b["x"] @ p["wu"]
+    pred = h @ p["w2"] + p["b"]
+    return jnp.mean((b["y"] - pred) ** 2)
+
+
+CONFIGS = {
+    # PS/ZeRO: full weight-update sharding; Adam states shard along reduce.
+    "ps": dict(builder=lambda: PS(), mesh=None,
+               optimizer=lambda: optax.adam(1e-2)),
+    # Model-axis storage with a padded-uneven param (7 -> 8 over 2 shards).
+    "partitioned": dict(builder=lambda: UnevenPartitionedPS(),
+                        mesh={"model": 2, "data": -1},
+                        optimizer=lambda: optax.sgd(LR)),
+    # Explicit shard_map lowering: sparse wire + BF16_EF on dense grads.
+    "parallax": dict(
+        builder=lambda: Parallax(compressor="HorovodCompressorEF"),
+        mesh=None, optimizer=lambda: optax.sgd(LR)),
+}
+
+
+def _shard_evidence(state, runner):
+    """Physical-sharding facts the driver asserts (chief's local view)."""
+    from autodist_tpu.parallel.synchronization import EFState
+    ev = {}
+    w2_opt_shards = None
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        if getattr(leaf, "ndim", 0) == 2 and leaf.shape[-1] == DIM \
+                and leaf.shape[0] == DIM:
+            w2_opt_shards = sorted({tuple(s.data.shape)
+                                    for s in leaf.addressable_shards})
+            break
+    ev["w2_opt_shard_shapes"] = w2_opt_shards
+    ev["wu_storage_shape"] = list(state.params["wu"].shape)
+    ev["wu_shard_shapes"] = sorted({tuple(s.data.shape)
+                                    for s in state.params["wu"].addressable_shards})
+    ev["sparse_wire_params"] = sorted(runner.plan.sparse_wire_params)
+    ef = state.ef_state
+    leaves = jax.tree_util.tree_leaves(
+        ef, is_leaf=lambda x: isinstance(x, EFState))
+    ev["ef_params_dp"] = sorted(
+        int(l.error.shape[0]) for l in leaves if isinstance(l, EFState))
+    return ev
+
+
+def main(out_path: str, config: str):
+    cfg = CONFIGS[config]
+    ad = AutoDist(_spec(cfg["mesh"]), cfg["builder"]())
+    params = make_params()
+    runner = ad.create_distributed_session(
+        loss_fn, params, cfg["optimizer"](), example_batch=make_batch(0))
+    if not SINGLE:
+        assert jax.process_count() == 2, f"process_count={jax.process_count()}"
+    assert jax.device_count() == 4, f"device_count={jax.device_count()}"
+
+    state = runner.init(params)
+    evidence = _shard_evidence(state, runner)
+    losses = []
+    for step in range(STEPS):
+        state, loss = runner.run(state, make_batch(step))
+        losses.append(float(loss))
+
+    if jax.process_index() == 0:
+        logical = jax.device_get(runner.logical_params(state))
+        result = {
+            "config": config,
+            "losses": losses,
+            "params": {k: np.asarray(v).tolist() for k, v in logical.items()},
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            **evidence,
+        }
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
+
+def run_single_reference(out_path: str, config: str, workdir: str,
+                         timeout: int = 300):
+    """Run this script once, single-process, on a 4-device sim mesh."""
+    import subprocess
+
+    from examples.multiprocess_linear_regression import ROLE_ENV_VARS
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    for k in ROLE_ENV_VARS:
+        env.pop(k, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "AUTODIST_WORKING_DIR": workdir,
+        "AUTODIST_MATRIX_SINGLE": "1",
+        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), out_path, config],
+        env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

@@ -202,7 +202,10 @@ def test_async_restore_reseeds_before_updates_and_raises_after():
     import dataclasses
     restored = dataclasses.replace(state0, params={"w": jnp.ones(()), "b": jnp.ones(())})
     new_state, _ = runner.run(restored, batch)
-    assert runner.service.version == 1
+    assert runner.service.updates_applied == 1
+    # The adoption itself opened a new generation (so any cached conditional
+    # pull refetches), then the step's apply advanced it again.
+    assert runner.service.version == 2
     # After updates, a foreign state is ambiguous -> explicit restore required.
     with pytest.raises(RuntimeError, match="restore"):
         runner.run(restored, batch)

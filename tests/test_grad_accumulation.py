@@ -259,6 +259,29 @@ def test_ambiguous_batch_dim_raises_and_batch_size_resolves():
     assert np.isfinite(float(loss))
 
 
+def test_splittable_outlier_does_not_hijack_indivisible_batch():
+    """When the true (modal) batch dim is NOT divisible by accum*dp but an
+    auxiliary leaf is, the aux leaf must not be silently micro-split in the
+    batch's place: the inference refuses and names both dims."""
+    rng = np.random.RandomState(13)
+    batch = {"x": rng.randn(24, 4).astype(np.float32),   # 24 % (2*8) != 0
+             "y": rng.randn(24, 1).astype(np.float32),
+             "neg": rng.randn(32, 1).astype(np.float32)}  # 32 % 16 == 0
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"] + p["b"]
+        pen = jnp.mean((pred[:, None, :] - b["neg"][None, :, :]) ** 2)
+        return jnp.mean((b["y"] - pred) ** 2) + 0.1 * pen
+
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(
+        loss_fn, _dense_params(), optax.sgd(0.05), example_batch=batch,
+        accumulation_steps=2)
+    state = runner.init(_dense_params())
+    with pytest.raises(ValueError, match="most common leading dim"):
+        runner.run(state, batch)
+
+
 def test_indivisible_batch_raises():
     ad = AutoDist(strategy_builder=AllReduce())
     runner = ad.create_distributed_session(

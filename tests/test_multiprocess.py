@@ -127,6 +127,91 @@ def test_cross_process_bounded_staleness_ps(tmp_path):
     assert all(d > aps.SLOW_SLEEP * 0.3 for d in gated), durations
 
 
+def _run_matrix_config(tmp_path, config):
+    """Run one strategy-matrix config in BOTH modes and return (single, two)."""
+    import os
+
+    import tests.strategy_matrix_mp_script as matrix
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "strategy_matrix_mp_script.py")
+    single_out = tmp_path / f"{config}_single.json"
+    proc = matrix.run_single_reference(str(single_out), config,
+                                       str(tmp_path / "workdir_single"))
+    assert proc.returncode == 0, (
+        f"single-process reference failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    two_out = tmp_path / f"{config}_two.json"
+    proc = mp_script.run_two_process_chief(
+        str(two_out), str(tmp_path / "workdir_two"), script=script,
+        extra_args=(config,))
+    assert proc.returncode == 0, (
+        f"2-process chief failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    single = json.loads(single_out.read_text())
+    two = json.loads(two_out.read_text())
+    assert two["process_count"] == 2 and two["device_count"] == 4
+    assert single["process_count"] == 1 and single["device_count"] == 4
+    # Same global mesh => the distributed run must be value-exact vs the
+    # single-process reference (the reference's c0 criterion per strategy,
+    # tests/integration/test_dist.py:14-42).
+    np.testing.assert_allclose(two["losses"], single["losses"],
+                               rtol=1e-5, atol=1e-6)
+    for k in single["params"]:
+        np.testing.assert_allclose(two["params"][k], single["params"][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    return single, two
+
+
+def test_cross_process_ps_zero_sharded_opt_state(tmp_path):
+    """PS/ZeRO across 2 real processes: Adam moments physically sharded along
+    the reduce axis that spans the process boundary, training value-exact."""
+    single, two = _run_matrix_config(tmp_path, "ps")
+    # w2 is (4,4); ZeRO shards dim0 over reduce=4, so the chief's 2 local
+    # devices each hold a (1,4) tile of each Adam moment — across processes.
+    assert two["w2_opt_shard_shapes"] == [[1, 4]]
+    assert single["w2_opt_shard_shapes"] == [[1, 4]]
+
+
+def test_cross_process_partitioned_padded_uneven_storage(tmp_path):
+    """UnevenPartitionedPS across 2 real processes: the 7-row parameter lives
+    padded to 8 on a model axis spanning both processes, each device holding a
+    (4, DIM) tile; updates stay value-exact (pad rows masked)."""
+    single, two = _run_matrix_config(tmp_path, "partitioned")
+    assert two["wu_storage_shape"] == [8, 4]
+    assert two["wu_shard_shapes"] == [[4, 4]]
+
+
+def test_cross_process_parallax_sparse_wire_with_ef(tmp_path):
+    """Parallax + BF16_EF across 2 real processes: the explicit shard_map
+    lowering — sparse (indices, rows) wire for the embedding, bf16 error
+    feedback on dense gradients — runs over a cross-process mesh and matches
+    the single-process run exactly (same shard count => same rounding)."""
+    single, two = _run_matrix_config(tmp_path, "parallax")
+    assert two["sparse_wire_params"] == ["emb"]
+    # Three dense params (wu, w2, b) carry per-replica EF residuals at dp=4.
+    assert two["ef_params_dp"] == [4, 4, 4]
+
+
+def test_async_ps_example_runs(tmp_path):
+    """The documented async-PS example (examples/async_ps_train.py) runs
+    end-to-end: 2 processes, all updates applied, wire accounting reported."""
+    import os
+
+    script = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          "examples", "async_ps_train.py")
+    out = tmp_path / "example_summary.json"
+    proc = mp_script.run_two_process_chief(
+        str(out), str(tmp_path / "workdir"), script=script,
+        extra_args=("--steps", "4", "--out", str(out)))
+    assert proc.returncode == 0, (
+        f"example failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+    summary = json.loads(out.read_text())
+    assert summary["applied_updates"] == 8  # 4 chief + 4 worker
+    assert summary["worker_wire_received_bytes"] > 0
+
+
 def test_auto_wired_cross_process_async_ps(tmp_path):
     """The public API alone (2-node spec + PS(staleness)) wires the whole async
     protocol: worker launch, transport address shipping, chief-side serving,

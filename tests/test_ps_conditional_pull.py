@@ -116,6 +116,35 @@ def test_conditional_pull_saves_wire_bytes():
         server.close()
 
 
+def test_conditional_pull_sees_checkpoint_restore():
+    """restore()/adopt() must defeat the conditional-pull cache: the version is
+    a never-reused generation counter, so a worker that cached params at some
+    version can never be told 'not modified' about a restored state."""
+    import dataclasses
+
+    from autodist_tpu.parallel.ps_transport import PSServer, RemotePSWorker
+
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(
+        _loss, _params(), optax.sgd(0.01), example_batch=_data(), num_workers=1)
+    state = runner.init(_params())
+    server = PSServer(runner, host="127.0.0.1")
+    host, port = server.address
+    remote = RemotePSWorker(f"{host}:{port}", runner, worker_id=0)
+    try:
+        remote.warmup(_data())  # caches params at the initial version
+        restored = dataclasses.replace(
+            state, params={"w": jnp.ones((PARAM_ROWS, PARAM_COLS)),
+                           "b": jnp.ones((PARAM_COLS,))})
+        runner.restore(restored)
+        params, _, version = remote._pull()
+        assert version == 1  # reset opened generation 1, it did not restart at 0
+        np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+    finally:
+        remote.close()
+        server.close()
+
+
 def test_conditional_pull_concurrent_writer_still_fresh():
     """A second writer applying between a worker's pulls must defeat the cache:
     read_if_newer returns the NEW tree, never a stale cached one."""
