@@ -1,0 +1,138 @@
+"""FLOPs and MFU accounting for benchmark reporting.
+
+The reference's benchmark suite reported raw rates only (examples/sec,
+``examples/benchmark/utils/logs/metric.py``); rates alone cannot show whether a
+regression is the framework or the model shape. Every README table row here
+additionally carries MFU (model FLOPs utilization = achieved FLOP/s over the
+chip's peak), from one of two estimators:
+
+- :func:`train_step_flops` — XLA's own cost analysis of the compiled train
+  step. Exact for what the chip executes, but blind to pallas custom calls
+  (Mosaic kernels report no flops) and inflated by rematerialization.
+- :func:`transformer_flops_per_token` — the standard analytic decoder count
+  (attention projections + score/value matmuls + MLP + vocab head, backward =
+  2x forward). Used for the LM benches whose hot path is pallas.
+
+Peak FLOP/s comes from the device kind (bf16 peak), overridable with
+``AUTODIST_PEAK_FLOPS`` for new hardware.
+"""
+
+import os
+from typing import Optional
+
+# bf16 peak FLOP/s per chip by device_kind prefix (public spec sheets).
+_PEAK_BF16 = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e (Trillium)
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Per-device bf16 peak FLOP/s, or None when unknown (e.g. CPU)."""
+    override = os.environ.get("AUTODIST_PEAK_FLOPS")
+    if override:
+        return float(override)
+    try:
+        import jax
+        device = device or jax.devices()[0]
+    except Exception:  # noqa: BLE001
+        return None
+    if device.platform == "cpu":
+        return None
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix, peak in _PEAK_BF16.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def _flops_from_cost(cost) -> Optional[float]:
+    if cost is None:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    try:
+        flops = float(cost.get("flops", 0.0))
+    except Exception:  # noqa: BLE001
+        return None
+    return flops if flops > 0 else None
+
+
+def train_step_flops(runner, state, sharded_batch) -> Optional[float]:
+    """PER-DEVICE FLOPs of one compiled training step, from XLA's cost
+    analysis (the SPMD module computes one device's batch shard — exactly the
+    numerator MFU against a per-device peak wants).
+
+    ``runner`` is a DistributedRunner whose plain step (no fetches) has already
+    compiled — lowering again hits the jit cache. Returns None when the backend
+    reports no analysis (or the step is pallas-dominated and reports ~0)."""
+    fn = runner._step_fns.get(None)
+    if fn is None:
+        return None
+    try:
+        with runner.mesh:
+            cost = fn.lower(state, sharded_batch).compile().cost_analysis()
+    except Exception:  # noqa: BLE001 — accounting must never break a bench
+        return None
+    return _flops_from_cost(cost)
+
+
+def jit_flops(jitted, *args) -> Optional[float]:
+    """Cost-analysis FLOPs for an arbitrary jitted callable at ``args``."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+    except Exception:  # noqa: BLE001
+        return None
+    return _flops_from_cost(cost)
+
+
+def transformer_flops_per_token(d_model: int, n_layers: int, d_ff: int,
+                                vocab_size: int, seq_len: int,
+                                n_experts_active: int = 1) -> float:
+    """Analytic training FLOPs per token for a decoder LM.
+
+    Forward per token: ``8*d^2`` attention projections + ``4*s*d`` score/value
+    matmuls per layer, ``4*d*d_ff`` MLP per layer (times the active expert
+    count for MoE), ``2*d*V`` vocab head; training = 3x forward (backward is
+    2x). Matches the usual 6ND + attention accounting; the full score matrix
+    is counted because that is what the kernels execute (the causal mask
+    discards, not skips, the upper triangle)."""
+    per_layer = (8 * d_model * d_model + 4 * seq_len * d_model
+                 + 4 * d_model * d_ff * n_experts_active)
+    fwd = n_layers * per_layer + 2 * d_model * vocab_size
+    return 3.0 * fwd
+
+
+def mfu(flops_per_sec: Optional[float],
+        peak: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1], or None when either side is unknown."""
+    peak = peak if peak is not None else device_peak_flops()
+    if not flops_per_sec or not peak:
+        return None
+    return flops_per_sec / peak
+
+
+def format_mfu(value: Optional[float]) -> str:
+    return f"{100.0 * value:.1f}%" if value is not None else "n/a"
+
+
+def report_mfu(flops_per_step: Optional[float], steps_per_sec: Optional[float],
+               label: str = "mfu") -> Optional[float]:
+    """Print the benchmark scripts' shared MFU line; returns the MFU fraction.
+
+    Line format is part of the tooling contract: ``run_all.py`` scrapes
+    ``<label> <pct>%``."""
+    if not flops_per_step or not steps_per_sec:
+        return None
+    value = mfu(flops_per_step * steps_per_sec)
+    if value is None:
+        return None
+    print(f"{label} {100.0 * value:.2f}% "
+          f"({flops_per_step * steps_per_sec / 1e12:.1f} TFLOP/s, "
+          f"{flops_per_step / 1e9:.2f} GFLOP/step)")
+    return value

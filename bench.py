@@ -78,12 +78,21 @@ def main():
     tokens_per_sec = tokens_per_step * n_steps / dt
     per_device = tokens_per_sec / n_dev
 
+    # MFU from the analytic per-token count (the fused pallas head is invisible
+    # to XLA's flop analysis, so the compiled-module count would under-report).
+    from autodist_tpu.utils import flops as flops_util
+    flops_per_token = flops_util.transformer_flops_per_token(
+        cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, seq_len)
+    mfu = flops_util.mfu(flops_per_token * tokens_per_sec / n_dev)
+
     print(json.dumps({
         "metric": f"transformer_lm_train_tokens_per_sec ({platform} x{n_dev}, "
                   f"d{cfg.d_model}x{cfg.n_layers}, seq{seq_len}, bs{batch_size})",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(per_device / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3),
+        "flops_per_token": round(flops_per_token),
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }))
 
 

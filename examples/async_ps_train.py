@@ -69,6 +69,12 @@ def loss_fn(p, b):
 
 
 def main(steps: int, staleness: int, out_path: str = None):
+    if not const.is_worker():
+        # A stale report from a previous run must not mask a worker crash.
+        try:
+            os.remove(_worker_report_path())
+        except FileNotFoundError:
+            pass
     ad = AutoDist(SPEC, PS(sync=True, staleness=staleness))
     params = {"w": np.zeros((DIM, 1), np.float32),
               "b": np.zeros((1,), np.float32)}

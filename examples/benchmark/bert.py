@@ -65,6 +65,10 @@ def main(argv=None):
         meter.step(sync=loss)
     print(f"bert-{args.size}: final loss {float(loss):.4f}, "
           f"{meter.average or 0:.1f} examples/sec")
+    from autodist_tpu.utils import flops as flops_util
+    flops_util.report_mfu(
+        flops_util.train_step_flops(step.runner, step.get_state(), batch),
+        (meter.average or 0) / batch_size)
     return meter.average
 
 

@@ -122,6 +122,10 @@ def main(argv=None):
     bench_logger.on_finish()
     print(f"{args.model}/{args.strategy}: final loss {float(loss):.4f}, "
           f"{avg:.1f} examples/sec ({avg / max(n_dev, 1):.1f}/device)")
+    from autodist_tpu.utils import flops as flops_util
+    flops_util.report_mfu(
+        flops_util.train_step_flops(step.runner, step.get_state(), batch),
+        avg / batch_size)
     return avg
 
 

@@ -43,11 +43,11 @@ CONFIGS = {
                                "--steps", s, "--log_every", s],
                     "examples/s", RATE + r" examples/sec"),
     "bert_base": (lambda s: [os.path.join(ROOT, "examples/benchmark/bert.py"),
-                             "--size", "base", "--batch_size", "128",
+                             "--size", "base", "--batch_size", "256",
                              "--steps", s, "--log_every", s],
                   "examples/s", RATE + r" examples/sec"),
     "bert_large": (lambda s: [os.path.join(ROOT, "examples/benchmark/bert.py"),
-                              "--size", "large", "--batch_size", "16",
+                              "--size", "large", "--batch_size", "128",
                               "--steps", s, "--log_every", s],
                    "examples/s", RATE + r" examples/sec"),
     "lm1b_lstm": (lambda s: [os.path.join(ROOT, "examples/lm1b/lm1b_train.py"),
@@ -68,14 +68,25 @@ def run_config(name: str, steps: str):
     proc = subprocess.run(cmd, capture_output=True, text=True)
     out = proc.stdout + proc.stderr
     if proc.returncode != 0:
-        return {"name": name, "unit": unit, "rate": None,
+        return {"name": name, "unit": unit, "rate": None, "mfu_pct": None,
                 "error": out.strip().splitlines()[-1] if out.strip() else "failed"}
     matches = re.findall(pattern, out)
     if not matches:
-        return {"name": name, "unit": unit, "rate": None,
+        return {"name": name, "unit": unit, "rate": None, "mfu_pct": None,
                 "error": "no rate found in output"}
     rate = float(matches[-1].replace(",", ""))
-    return {"name": name, "unit": unit, "rate": rate, "error": None}
+    # Scripts print a shared "mfu N.NN%" line (flops.report_mfu); bench.py
+    # reports the fraction in its JSON line instead.
+    mfu_pct = None
+    m = re.findall(r"mfu ([\d.]+)%", out)
+    if m:
+        mfu_pct = float(m[-1])
+    else:
+        m = re.findall(r'"mfu": ([\d.]+)', out)
+        if m:
+            mfu_pct = round(100.0 * float(m[-1]), 2)
+    return {"name": name, "unit": unit, "rate": rate, "mfu_pct": mfu_pct,
+            "error": None}
 
 
 def main(argv=None):
@@ -111,7 +122,9 @@ def main(argv=None):
         if r["rate"] is None:
             print(f"{r['name']:<{width}}  FAILED: {r['error']}")
         else:
-            print(f"{r['name']:<{width}}  {r['rate']:>14,.1f} {r['unit']}")
+            mfu = (f"  mfu {r['mfu_pct']:.1f}%" if r.get("mfu_pct") is not None
+                   else "")
+            print(f"{r['name']:<{width}}  {r['rate']:>14,.1f} {r['unit']}{mfu}")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1)

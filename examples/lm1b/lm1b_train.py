@@ -85,6 +85,14 @@ def main(argv=None):
         loss = step(batch)
         meter.step(sync=loss)
     print(f"final loss {float(loss):.4f}; average {meter.average or 0:.1f} words/sec")
+    if not getattr(args, "full_softmax", False):
+        # XLA cost analysis of the compiled step (skipped for --full_softmax,
+        # whose fused pallas loss is invisible to the analysis).
+        from autodist_tpu.utils import flops as flops_util
+        tokens_per_step = args.batch_size * args.seq_len
+        flops_util.report_mfu(
+            flops_util.train_step_flops(step.runner, step.get_state(), batch),
+            (meter.average or 0) / tokens_per_step)
     return meter.average
 
 

@@ -65,6 +65,18 @@ def main(argv=None):
     print(f"moe: final loss {float(loss):.4f}; "
           f"average {meter.average or 0:.1f} tokens/sec "
           f"(mesh={dict(step.runner.mesh.shape)})")
+    # Analytic count (the fused pallas head is invisible to XLA's analysis):
+    # Switch-style top-1 routing runs one expert MLP per token. Per-device
+    # tokens/s against the per-device peak, like bench.py.
+    import jax
+
+    from autodist_tpu.utils import flops as flops_util
+    tokens_per_step = args.batch_size * args.seq_len
+    fpt = flops_util.transformer_flops_per_token(
+        cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, args.seq_len)
+    flops_util.report_mfu(
+        fpt * tokens_per_step / len(jax.devices()),
+        (meter.average or 0) / tokens_per_step)
     return meter.average
 
 
