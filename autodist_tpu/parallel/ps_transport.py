@@ -175,15 +175,29 @@ class PSServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                # The worker id this connection drives (from its gate messages):
-                # needed to free the gate if the worker dies mid-step.
+                # The worker id this connection drives (from its gate or
+                # register messages) + the slot generation it observed:
+                # needed to free the gate if the worker dies mid-step, and to
+                # make that retire a no-op if a replacement has re-registered
+                # the slot since (a stale socket's death must not retire the
+                # live occupant).
                 self.worker_id = None
+                self.worker_gen = 0
+                controller = outer._runner.controller
                 try:
                     while True:
                         msg, _ = _recv_msg(self.request)
+                        reply = outer._dispatch(msg)
                         if msg[0] in ("start_step", "finish_step"):
                             self.worker_id = msg[1]
-                        _send_msg(self.request, outer._dispatch(msg))
+                            self.worker_gen = controller.generation(msg[1])
+                        elif msg[0] == "register" and reply[0] == "ok":
+                            # Covers a replacement that registers and dies
+                            # before its first step (and worker_id=None
+                            # allocations, whose id only the reply knows).
+                            self.worker_id = reply[1]
+                            self.worker_gen = controller.generation(reply[1])
+                        _send_msg(self.request, reply)
                 except (ConnectionError, OSError):
                     # A vanished worker must not freeze the staleness gate for
                     # everyone else (its step count would pin min(steps) forever).
@@ -191,7 +205,8 @@ class PSServer:
                         logging.warning(
                             "PS worker %s disconnected; retiring it from the "
                             "staleness gate", self.worker_id)
-                        outer._runner.controller.retire(self.worker_id)
+                        controller.retire(self.worker_id,
+                                          generation=self.worker_gen)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -236,6 +251,8 @@ class PSServer:
             if op == "finish_step":
                 r.controller.finish_step(msg[1])
                 return ("ok",)
+            if op == "register":
+                return ("ok", r.controller.register(msg[1]))
             if op == "version":
                 return ("ok", r.service.version)
             return ("error", "PSClientError", f"unknown op {op!r}")
@@ -320,6 +337,15 @@ class RemotePSWorker:
     def wire_bytes(self) -> Tuple[int, int]:
         """(sent, received) payload bytes over this worker's transport."""
         return self._client.bytes_sent, self._client.bytes_received
+
+    def register(self) -> int:
+        """(Re-)admit this worker to the chief's staleness gate — the elastic
+        rejoin for a replacement process after the original disconnected and
+        was retired. Seeds the gate at the slowest live worker's step count;
+        returns the admitted id (may differ when ``worker_id`` was None)."""
+        (wid,) = self._client.call("register", self.worker_id)
+        self.worker_id = wid
+        return wid
 
     def warmup(self, batch: PyTree) -> None:
         """Compile this worker's gradient program without applying an update

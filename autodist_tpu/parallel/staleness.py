@@ -57,6 +57,10 @@ class StalenessController:
         self._bound = staleness if staleness > 0 else math.inf
         self._steps = [0] * num_workers
         self._retired = set()
+        # Per-slot generation, bumped by register(): lets a disconnect handler
+        # that observed an OLD occupant of a slot retire conditionally, so a
+        # stale socket's death can never retire the live replacement.
+        self._generation: dict = {}
         self._cond = threading.Condition()
 
     @property
@@ -68,13 +72,62 @@ class StalenessController:
         live = [s for i, s in enumerate(self._steps) if i not in self._retired]
         return not live or self._steps[worker_id] - min(live) < self._bound
 
-    def retire(self, worker_id: int):
+    def generation(self, worker_id: int) -> int:
+        """Current occupancy generation of a slot (bumped by register())."""
+        with self._cond:
+            return self._generation.get(worker_id, 0)
+
+    def retire(self, worker_id: int, generation: Optional[int] = None):
         """Remove a dead worker from the gate (its frozen step count would
         otherwise pin min(steps) and wedge every other worker at the bound).
-        Used by the PS transport when a remote worker disconnects."""
+        Used by the PS transport when a remote worker disconnects.
+
+        With ``generation``, the retire applies only if the slot's occupancy
+        generation still matches — a handler holding a long-dead socket for a
+        slot that a replacement has since re-registered must not retire the
+        live replacement."""
         with self._cond:
+            if generation is not None \
+                    and generation != self._generation.get(worker_id, 0):
+                logging.info("Ignoring stale retire of worker %d (generation "
+                             "%d != current %d)", worker_id, generation,
+                             self._generation.get(worker_id, 0))
+                return
             self._retired.add(worker_id)
             self._cond.notify_all()
+
+    def register(self, worker_id: Optional[int] = None) -> int:
+        """Admit a worker to the gate mid-run — a replacement for a retired
+        worker (same or new id) or an elastic addition (``None`` allocates the
+        next id). Returns the admitted id. Registering a slot that is already
+        live is an idempotent no-op (a client retrying after a transport
+        hiccup must not reset a live worker's count — that would let it run
+        past the staleness bound).
+
+        The admitted worker's completed-step count seeds at the slowest LIVE
+        worker's count: seeding at 0 would pin ``min(steps)`` and wedge every
+        other worker at the bound until the newcomer caught up; seeding at the
+        max would let it surge ``bound`` steps ahead of the true slowest. (The
+        reference had no elastic membership at all — fail-fast only,
+        ``coordinator.py:98-110``.)"""
+        with self._cond:
+            if worker_id is not None and worker_id < len(self._steps) \
+                    and worker_id not in self._retired:
+                return worker_id  # already live: idempotent
+            if worker_id is None:
+                worker_id = len(self._steps)
+            while worker_id >= len(self._steps):
+                # Intermediate brand-new slots stay retired until registered.
+                self._steps.append(0)
+                self._retired.add(len(self._steps) - 1)
+            self._retired.discard(worker_id)
+            self._generation[worker_id] = self._generation.get(worker_id, 0) + 1
+            live = [s for i, s in enumerate(self._steps)
+                    if i not in self._retired and i != worker_id]
+            if live:
+                self._steps[worker_id] = min(live)
+            self._cond.notify_all()
+            return worker_id
 
     def start_step(self, worker_id: int, timeout: Optional[float] = None):
         """Block until the worker is within the staleness bound.
@@ -338,6 +391,23 @@ class AsyncPSRunner(DistributedRunner):
         if not 0 <= worker_id < self.num_workers:
             raise ValueError(f"worker_id {worker_id} out of range [0, {self.num_workers})")
         return self._workers[worker_id]
+
+    def add_worker(self, worker_id: Optional[int] = None) -> AsyncWorker:
+        """Elastically (re-)admit a worker slot mid-run: a replacement for a
+        retired (crashed) worker, or a brand-new slot (``worker_id=None``).
+        Returns its handle; the gate seeds its step count at the slowest live
+        worker's (see :meth:`StalenessController.register`). The reference
+        could only fail-fast on worker loss (``coordinator.py:98-110``); the
+        retire + register pair makes membership elastic."""
+        if self.service is None:
+            raise RuntimeError("Call init(params) before creating workers")
+        wid = self.controller.register(worker_id)
+        self.num_workers = max(self.num_workers, wid + 1)
+        if wid not in self._workers:
+            self._workers[wid] = AsyncWorker(self, wid)
+        logging.info("AsyncPSRunner: admitted worker %d (gate now %d slots)",
+                     wid, len(self.controller.steps))
+        return self._workers[wid]
 
     def _place(self, state: TrainState) -> TrainState:
         """Place a state onto the mesh with the service's shardings (jit cached

@@ -42,7 +42,8 @@ import optax  # noqa: E402
 
 from autodist_tpu import AutoDist  # noqa: E402
 from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
-from autodist_tpu.strategy import PS, Parallax, UnevenPartitionedPS  # noqa: E402
+from autodist_tpu.strategy import (AllReduce, PS, Parallax,  # noqa: E402
+                                   UnevenPartitionedPS)
 
 BATCH = 16
 LR = 0.05
@@ -98,6 +99,23 @@ CONFIGS = {
     "parallax": dict(
         builder=lambda: Parallax(compressor="HorovodCompressorEF"),
         mesh=None, optimizer=lambda: optax.sgd(LR)),
+    # Hierarchical two-phase reduce across the process boundary: the inner
+    # `reduce` axis lies within each process's 2 devices (the ICI tier on a
+    # real pod), the outer `data` axis spans the two processes (the DCN tier).
+    # jax.devices() lists process 0's devices first, so the row-major [data,
+    # reduce] mesh puts reduce innermost-per-process by construction.
+    "dcn": dict(
+        builder=lambda: AllReduce(all_reduce_spec="DCN",
+                                  compressor="HorovodCompressor",
+                                  chunk_size=4),
+        mesh={"data": 2, "reduce": 2},
+        optimizer=lambda: optax.sgd(LR)),
+    # Low-rank PowerSGD factors (P/Q matmuls + QR + two factor pmeans) across
+    # the process boundary; deterministic, so exact vs single-process.
+    "powersgd": dict(
+        builder=lambda: AllReduce(compressor="PowerSGDCompressor",
+                                  power_sgd_rank=2),
+        mesh=None, optimizer=lambda: optax.sgd(LR)),
 }
 
 
@@ -150,6 +168,7 @@ def main(out_path: str, config: str):
             "params": {k: np.asarray(v).tolist() for k, v in logical.items()},
             "process_count": jax.process_count(),
             "device_count": jax.device_count(),
+            "mesh": {k: int(v) for k, v in dict(runner.mesh.shape).items()},
             **evidence,
         }
         with open(out_path, "w") as f:

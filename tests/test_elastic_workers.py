@@ -1,0 +1,196 @@
+"""Elastic async-PS membership: retire on failure, register a replacement.
+
+The reference's only failure policy was fail-fast — the coordinator hard-kills
+the chief on any worker exit (``coordinator.py:98-110``); this framework's
+retire/register pair makes the async plane's membership elastic: a crashed
+worker is retired from the staleness gate (round-2 feature), and a replacement
+process re-registers mid-run, seeded at the slowest live worker's step count so
+it neither wedges the gate nor surges past the bound.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist
+from autodist_tpu.parallel.staleness import StalenessController, StalenessTimeout
+from autodist_tpu.strategy import PS
+
+BATCH = 16
+
+
+def _data(seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(BATCH).astype(np.float32)
+    return {"x": x, "y": (2.0 * x - 1.0).astype(np.float32)}
+
+
+def _loss(p, b):
+    return jnp.mean((b["y"] - (b["x"] * p["w"] + p["b"])) ** 2)
+
+
+def _params():
+    return {"w": np.zeros((), np.float32), "b": np.zeros((), np.float32)}
+
+
+# ----------------------------------------------------------------- controller
+
+def test_register_seeds_at_slowest_live_count():
+    c = StalenessController(num_workers=2, staleness=2)
+    for _ in range(5):
+        c.start_step(0, timeout=1)
+        c.finish_step(0)
+        c.start_step(1, timeout=1)
+        c.finish_step(1)
+    c.retire(1)
+    # Replacement joins at min(live) = 5, NOT 0 (0 would wedge worker 0).
+    assert c.register(1) == 1
+    assert c.steps == [5, 5]
+    c.start_step(0, timeout=1)  # gate open: 5 - 5 < 2
+    c.finish_step(0)
+
+
+def test_register_zero_seed_would_have_wedged():
+    """The scenario the min(live) seed exists for: without it, a rejoined
+    worker at step 0 pins the gate for everyone at the bound."""
+    c = StalenessController(num_workers=2, staleness=1)
+    c.start_step(0, timeout=1)
+    c.finish_step(0)   # worker 0 at 1, worker 1 at 0 -> 0 is at the bound
+    c.retire(1)
+    c.register(1)      # seeds at 1, not 0
+    c.start_step(0, timeout=0.5)  # would raise StalenessTimeout with a 0 seed
+    c.finish_step(0)
+    with pytest.raises(StalenessTimeout):
+        c.start_step(0, timeout=0.2)  # now genuinely ahead of the replacement
+
+
+def test_register_live_slot_is_idempotent_noop():
+    """A retried register (transport hiccup) or an operator add_worker on a
+    live slot must NOT reset the worker's count — that would let it run up to
+    2x the staleness bound past the true slowest."""
+    c = StalenessController(num_workers=2, staleness=2)
+    for _ in range(2):
+        c.start_step(0, timeout=1)
+        c.finish_step(0)
+    assert c.register(0) == 0
+    assert c.steps == [2, 0]  # count preserved, no reseed past the bound
+
+
+def test_stale_retire_after_reregister_is_ignored():
+    """A handler that observed the OLD occupant of a slot (generation g) must
+    not retire the live replacement (generation g+1) when its dead socket
+    finally errors out."""
+    c = StalenessController(num_workers=2, staleness=2)
+    old_gen = c.generation(1)
+    c.retire(1)                      # old occupant's connection dies
+    c.register(1)                    # replacement joins -> generation bumps
+    c.retire(1, generation=old_gen)  # stale handler fires late: must no-op
+    c.start_step(1, timeout=1)       # slot is still live
+    c.finish_step(1)
+    # An unconditional retire (no generation) still works.
+    c.retire(1)
+    assert 1 not in [i for i in range(2) if i not in c._retired]
+
+
+def test_register_new_slot_allocates_next_id():
+    c = StalenessController(num_workers=2, staleness=0)
+    assert c.register() == 2
+    assert len(c.steps) == 3
+
+
+def test_register_sparse_id_leaves_gaps_retired():
+    c = StalenessController(num_workers=1, staleness=2)
+    assert c.register(3) == 3
+    assert len(c.steps) == 4
+    # The never-registered gap slots (1, 2) must not gate anyone.
+    c.start_step(0, timeout=1)
+    c.finish_step(0)
+    c.start_step(3, timeout=1)
+    c.finish_step(3)
+
+
+# ------------------------------------------------------------------ in-process
+
+def test_runner_add_worker_replaces_crashed_worker():
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(staleness=2))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.05),
+                                           example_batch=batch, num_workers=2)
+    runner.init(_params())
+    w0, w1 = runner.worker(0), runner.worker(1)
+    for _ in range(2):
+        w0.step(batch, timeout=5)
+        w1.step(batch, timeout=5)
+    runner.controller.retire(1)  # "crash"
+    # Worker 0 is not wedged by the frozen count...
+    for _ in range(3):
+        w0.step(batch, timeout=5)
+    # ...and a replacement rejoins at the live pace and gates normally.
+    w1b = runner.add_worker(1)
+    w1b.step(batch, timeout=5)
+    assert runner.service.updates_applied == 2 + 2 + 3 + 1
+    # A brand-new elastic slot works too.
+    w2 = runner.add_worker()
+    assert w2.worker_id == 2
+    w2.step(batch, timeout=5)
+    assert runner.service.updates_applied == 9
+
+
+# ------------------------------------------------------------------ transport
+
+def test_remote_replacement_worker_reregisters():
+    """End-to-end over the loopback transport: a remote worker disconnects
+    (server retires it), a NEW RemotePSWorker for the same slot re-registers
+    and training continues — the elastic-recovery path the reference lacked."""
+    from autodist_tpu.parallel.ps_transport import PSServer, RemotePSWorker
+
+    batch = _data()
+    ad = AutoDist(strategy_builder=PS(staleness=2))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.05),
+                                           example_batch=batch, num_workers=2)
+    runner.init(_params())
+    server = PSServer(runner, host="127.0.0.1")
+    host, port = server.address
+    chief = runner.worker(0)
+
+    remote = RemotePSWorker(f"{host}:{port}", runner, worker_id=1)
+    remote.step(batch, timeout=10)
+    chief.step(batch, timeout=10)
+    remote.close()  # simulated crash: the server handler retires worker 1
+
+    # The chief keeps going (retirement frees the gate)...
+    import time
+    deadline = time.time() + 10
+    while 1 not in runner.controller._retired and time.time() < deadline:
+        time.sleep(0.02)
+    for _ in range(4):
+        chief.step(batch, timeout=10)
+
+    # ...and a replacement process re-registers the slot and steps.
+    remote2 = RemotePSWorker(f"{host}:{port}", runner, worker_id=1)
+    assert remote2.register() == 1
+    for _ in range(2):
+        remote2.step(batch, timeout=10)
+    assert runner.service.updates_applied == 1 + 1 + 4 + 2
+    # Gate is live again: the chief is bounded by the replacement's pace.
+    assert runner.controller.steps[1] >= 2
+
+    # A replacement that registers and dies BEFORE its first step must still
+    # be retired (the handler learns the id from the register op itself).
+    remote2.close()
+    deadline = time.time() + 10
+    while 1 not in runner.controller._retired and time.time() < deadline:
+        time.sleep(0.02)
+    assert 1 in runner.controller._retired
+    remote3 = RemotePSWorker(f"{host}:{port}", runner, worker_id=1)
+    assert remote3.register() == 1
+    remote3.close()  # dies having never stepped
+    deadline = time.time() + 10
+    while 1 not in runner.controller._retired and time.time() < deadline:
+        time.sleep(0.02)
+    assert 1 in runner.controller._retired
+    # The chief is not wedged by the stillborn replacement.
+    for _ in range(3):
+        chief.step(batch, timeout=10)
+    server.close()

@@ -193,6 +193,23 @@ def test_cross_process_parallax_sparse_wire_with_ef(tmp_path):
     assert two["ef_params_dp"] == [4, 4, 4]
 
 
+def test_cross_process_hierarchical_dcn_reduce(tmp_path):
+    """The DCN two-phase reduce laid out the way a real pod would be: inner
+    `reduce` axis within each process's devices (ICI tier), outer `data` axis
+    spanning the two processes (DCN tier). Value-exact vs single-process on
+    the same mesh (test_ar_knobs proves the lowering is two-phase; this
+    proves it EXECUTES across a process boundary)."""
+    single, two = _run_matrix_config(tmp_path, "dcn")
+    assert two["mesh"]["data"] == 2 and two["mesh"]["reduce"] == 2
+
+
+def test_cross_process_powersgd(tmp_path):
+    """PowerSGD's factor pmeans (P/Q low-rank wire) across 2 real processes,
+    exact vs the single-process run (deterministic QR + same shard count)."""
+    single, two = _run_matrix_config(tmp_path, "powersgd")
+    assert two["ef_params_dp"] == []  # PowerSGDState, not EFState, carries EF
+
+
 def test_async_ps_example_runs(tmp_path):
     """The documented async-PS example (examples/async_ps_train.py) runs
     end-to-end: 2 processes, all updates applied, wire accounting reported."""
