@@ -30,7 +30,7 @@ class TransformerLMConfig:
     dropout: float = 0.0          # deterministic by default (benchmark parity)
     dtype: Any = jnp.bfloat16     # activation/compute dtype (params stay f32)
     remat: bool = False           # jax.checkpoint each block
-    attention_impl: str = "dot"   # "dot" | "flash" | "ring" | "ulysses"
+    attention_impl: str = "dot"   # "dot" | "flash" | "blockwise" | "ring" | "ulysses"
     # Fused pallas head+loss (ops/fused_xent): logits never materialize in HBM.
     # Measured faster than the XLA head in the full step at vocab 32k and it
     # unlocks batch sizes whose logits would OOM; the bench runs with it on.
@@ -42,9 +42,11 @@ class TransformerLMConfig:
     tied_output: bool = True
 
     def __post_init__(self):
-        if self.attention_impl not in ("dot", "flash", "ring", "ulysses"):
+        if self.attention_impl not in ("dot", "flash", "blockwise", "ring",
+                                       "ulysses"):
             raise ValueError(f"Unknown attention_impl {self.attention_impl!r}; "
-                             f"valid: 'dot', 'flash', 'ring', 'ulysses'")
+                             f"valid: 'dot', 'flash', 'blockwise', 'ring', "
+                             f"'ulysses'")
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
 
@@ -84,6 +86,12 @@ class MultiHeadAttention(nn.Module):
         if cfg.attention_impl == "flash":
             from autodist_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "blockwise":
+            # Pure-JAX O(L) memory path: the long-context choice on backends
+            # where the pallas flash kernel cannot compile (dot materializes
+            # the [L, L] score matrices and OOMs at long sequences).
+            from autodist_tpu.ops.blockwise_attention import blockwise_attention
+            ctx = blockwise_attention(q, k, v, causal=True)
         elif cfg.attention_impl in ("ring", "ulysses"):
             # Valid only inside a shard_map binding the `seq` mesh axis with the
             # sequence dim sharded in ring order — the sequence-parallel path

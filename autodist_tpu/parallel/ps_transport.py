@@ -252,7 +252,9 @@ class PSServer:
                 r.controller.finish_step(msg[1])
                 return ("ok",)
             if op == "register":
-                return ("ok", r.controller.register(msg[1]))
+                # Through add_worker, not the bare controller: the chief-side
+                # runner's num_workers / handle table must track the gate.
+                return ("ok", r.add_worker(msg[1]).worker_id)
             if op == "version":
                 return ("ok", r.service.version)
             return ("error", "PSClientError", f"unknown op {op!r}")

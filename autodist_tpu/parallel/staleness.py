@@ -111,9 +111,17 @@ class StalenessController:
         reference had no elastic membership at all — fail-fast only,
         ``coordinator.py:98-110``.)"""
         with self._cond:
+            if worker_id is not None and worker_id < 0:
+                raise ValueError(f"worker_id must be >= 0, got {worker_id}")
             if worker_id is not None and worker_id < len(self._steps) \
                     and worker_id not in self._retired:
-                return worker_id  # already live: idempotent
+                # Already live: keep the count (a reseed would un-gate it) but
+                # DO bump the generation — a reconnecting client's retry means
+                # the old connection is dead, and its deferred retire must not
+                # remove the live reconnection.
+                self._generation[worker_id] = \
+                    self._generation.get(worker_id, 0) + 1
+                return worker_id
             if worker_id is None:
                 worker_id = len(self._steps)
             while worker_id >= len(self._steps):
@@ -388,8 +396,13 @@ class AsyncPSRunner(DistributedRunner):
     def worker(self, worker_id: int) -> AsyncWorker:
         if self.service is None:
             raise RuntimeError("Call init(params) before creating workers")
-        if not 0 <= worker_id < self.num_workers:
-            raise ValueError(f"worker_id {worker_id} out of range [0, {self.num_workers})")
+        if worker_id not in self._workers:
+            # Membership check, not a range check: sparse elastic ids can
+            # leave never-registered gap slots with no handle.
+            raise ValueError(
+                f"worker_id {worker_id} has no handle (known: "
+                f"{sorted(self._workers)}); use add_worker({worker_id}) to "
+                f"admit it")
         return self._workers[worker_id]
 
     def add_worker(self, worker_id: Optional[int] = None) -> AsyncWorker:

@@ -1,0 +1,122 @@
+"""Long-context LM training: flash attention + remat + fused head (+ optional
+sequence parallelism).
+
+The composition that sets the single-chip context ceiling (README
+§long-context: one v5e chip trains the flagship architecture at seq 8,192 ~97k
+tokens/s up to seq 65,536 ~17k, where plain dot-product attention OOMs at
+8,192 already):
+
+    PYTHONPATH=. python examples/long_context_lm.py --seq_len 8192
+    PYTHONPATH=. python examples/long_context_lm.py --seq_len 65536 --batch_size 1
+    # sequence parallelism over a mesh axis (ring attention across shards):
+    PYTHONPATH=. python examples/long_context_lm.py --seq_len 4096 --seq_axis 2
+
+- ``--attention auto`` (default) picks the pallas flash kernel where the
+  Mosaic backend compiles it and the pure-JAX blockwise path elsewhere (CPU).
+- ``--seq_axis k`` switches to the SequenceParallel strategy: activations
+  shard over a ``seq`` mesh axis and attention runs as ring attention, each
+  shard stepping the flash carry variant (reference has no long-context
+  support at all — SURVEY.md §5.7).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from autodist_tpu import AutoDist
+from autodist_tpu.models import transformer_lm
+from autodist_tpu.ops import mosaic_compiles
+from autodist_tpu.strategy import AllReduce, SequenceParallel
+from autodist_tpu.utils import flops as flops_util
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq_len", type=int, default=8192)
+    parser.add_argument("--batch_size", type=int, default=0,
+                        help="global batch (default: fills to ~393k tokens)")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--d_model", type=int, default=512)
+    parser.add_argument("--n_layers", type=int, default=6)
+    parser.add_argument("--vocab", type=int, default=32_000)
+    parser.add_argument("--attention", default="auto",
+                        choices=["auto", "flash", "blockwise", "dot"])
+    parser.add_argument("--seq_axis", type=int, default=0,
+                        help=">1 enables sequence parallelism over that many "
+                             "mesh shards (ring attention)")
+    parser.add_argument("--no_remat", action="store_true")
+    args = parser.parse_args(argv)
+
+    on_accel = jax.default_backend() != "cpu"
+    if args.attention == "auto":
+        # Pallas flash where Mosaic compiles it; elsewhere the pure-JAX
+        # blockwise path keeps the O(L) memory profile this example is about.
+        attention = "flash" if mosaic_compiles() else "blockwise"
+    else:
+        attention = args.attention
+    if args.seq_axis > 1:
+        attention = "ring"
+
+    # Default batch: keep ~393k tokens in flight (the flagship bench's 384*256*4)
+    # but at least one sequence.
+    batch_size = args.batch_size or max(1, 393_216 // args.seq_len)
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=8,
+        n_layers=args.n_layers, d_ff=4 * args.d_model,
+        max_len=args.seq_len, dtype=jnp.bfloat16 if on_accel else jnp.float32,
+        tied_output=False, remat=not args.no_remat,
+        attention_impl=attention, fused_head=mosaic_compiles())
+
+    model, params = transformer_lm.init_params(cfg)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=args.seq_len)
+
+    if args.seq_axis > 1:
+        from autodist_tpu.parallel.sequence import create_sequence_parallel_session
+        ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=args.seq_axis))
+        runner = create_sequence_parallel_session(ad, model, params,
+                                                  optax.adam(1e-3))
+        state = runner.init(params)
+
+        def step_fn(b):
+            nonlocal state
+            state, loss = runner.run(state, b)
+            return loss
+    else:
+        ad = AutoDist(strategy_builder=AllReduce())
+        loss_fn = transformer_lm.make_loss_fn(model)
+        step_fn = ad.function(loss_fn, params, optax.adam(1e-3),
+                              example_batch=batch)
+        runner = step_fn.runner
+    batch = runner.shard_batch(batch)
+
+    loss = step_fn(batch)
+    _ = float(loss)  # compile fence
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = step_fn(batch)
+    _ = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * args.seq_len
+    rate = tokens_per_step * args.steps / dt
+    print(f"long-context seq={args.seq_len} bs={batch_size} "
+          f"attention={attention} remat={cfg.remat} "
+          f"(mesh={dict(runner.mesh.shape)}): final loss {float(loss):.4f}, "
+          f"{rate:,.0f} tokens/sec")
+    fpt = flops_util.transformer_flops_per_token(
+        cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, args.seq_len)
+    flops_util.report_mfu(fpt * tokens_per_step / len(jax.devices()),
+                          rate / tokens_per_step)
+    return rate
+
+
+if __name__ == "__main__":
+    main()

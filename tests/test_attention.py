@@ -159,6 +159,23 @@ def test_transformer_with_flash_attention_matches_dot():
     _close(float(loss_dot), float(loss_flash), atol=0, rtol=1e-5)
 
 
+def test_transformer_with_blockwise_attention_matches_dot():
+    """attention_impl='blockwise' (the O(L)-memory pure-JAX path the
+    long-context example uses off-Mosaic) is value-identical to dot."""
+    import dataclasses
+    from autodist_tpu.models import transformer_lm
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_len=64,
+        dtype=jnp.float32)
+    model, params = transformer_lm.init_params(cfg)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=4, seq_len=32)
+    loss_dot = transformer_lm.make_loss_fn(model)(params, batch)
+    cfg_bw = dataclasses.replace(cfg, attention_impl="blockwise")
+    model_bw = transformer_lm.TransformerLM(cfg_bw)
+    loss_bw = transformer_lm.make_loss_fn(model_bw)(params, batch)
+    _close(float(loss_dot), float(loss_bw), atol=0, rtol=1e-5)
+
+
 def test_flash_carry_matches_blockwise_carry():
     """The pallas carry variant and the pure-JAX carry produce the same
     (acc, m, l) state, including with offsets and a carry-in (the ring step)."""

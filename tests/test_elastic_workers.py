@@ -93,6 +93,26 @@ def test_stale_retire_after_reregister_is_ignored():
     assert 1 not in [i for i in range(2) if i not in c._retired]
 
 
+def test_idempotent_register_still_bumps_generation():
+    """A reconnecting client retries register on a LIVE slot (its old
+    connection is dead but the server hasn't noticed): the count must stay,
+    but the generation must bump so the old connection's deferred retire
+    cannot remove the live reconnection."""
+    c = StalenessController(num_workers=2, staleness=2)
+    old_gen = c.generation(1)
+    c.register(1)  # idempotent: live slot
+    assert c.generation(1) == old_gen + 1
+    c.retire(1, generation=old_gen)  # old connection finally dies: no-op
+    c.start_step(1, timeout=1)
+    c.finish_step(1)
+
+
+def test_register_rejects_negative_id():
+    c = StalenessController(num_workers=2, staleness=2)
+    with pytest.raises(ValueError, match=">= 0"):
+        c.register(-1)
+
+
 def test_register_new_slot_allocates_next_id():
     c = StalenessController(num_workers=2, staleness=0)
     assert c.register() == 2
@@ -135,6 +155,11 @@ def test_runner_add_worker_replaces_crashed_worker():
     assert w2.worker_id == 2
     w2.step(batch, timeout=5)
     assert runner.service.updates_applied == 9
+    # Sparse elastic ids: gap slots have no handle and say so.
+    w5 = runner.add_worker(5)
+    assert w5.worker_id == 5
+    with pytest.raises(ValueError, match="no handle"):
+        runner.worker(4)
 
 
 # ------------------------------------------------------------------ transport
@@ -173,6 +198,9 @@ def test_remote_replacement_worker_reregisters():
     for _ in range(2):
         remote2.step(batch, timeout=10)
     assert runner.service.updates_applied == 1 + 1 + 4 + 2
+    # A remote register routes through add_worker: chief-side bookkeeping
+    # (num_workers, handle table) tracks the gate.
+    assert runner.num_workers >= 2 and 1 in runner._workers
     # Gate is live again: the chief is bounded by the replacement's pace.
     assert runner.controller.steps[1] >= 2
 
