@@ -34,8 +34,10 @@ cannot exist: bs 384 (~428k tokens/s, the flagship bench config) OOMs with a
 materialized head. Larger still: V=262k (32 GiB of logits) and N=262k
 (16 GiB) both train where XLA OOMs, and the lm1b example trains its exact
 793,471-word vocabulary with the TRUE softmax objective (48 GiB of logits if
-materialized; the reference needed sampled softmax) at ~38k words/s/chip end
-to end. (An isolated loss+grads microbench is near-parity — 73 vs 69 ms —
+materialized; the reference needed sampled softmax) at ~17k words/s/chip end
+to end (bs 96, Adafactor — Adam's unfactored moments on the 4.9 GiB of
+tables exceed one chip's HBM).
+(An isolated loss+grads microbench is near-parity — 73 vs 69 ms —
 because the two backward logit recomputes cost roughly what the avoided HBM
 traffic saves; inside the full step, overlap with the rest of the model tips
 it to a win.)
@@ -116,6 +118,50 @@ def _shapes(h, w, bn, bv, w_vd: bool):
     return n, d, v, pl.cdiv(n, bn), pl.cdiv(v, bv)
 
 
+# Per-core VMEM the kernels may plan against (v5e has 16 MiB; leave headroom
+# for the compiler's own buffers). Exceeding it does not fail cleanly — the
+# Mosaic backend can die mid-compile — so block sizes are fitted up front.
+_VMEM_BUDGET = 14 << 20
+
+
+def _fit_blocks(d: int, bn: int, bv: int, h_size: int, w_size: int,
+                dw_kernel: bool):
+    """Shrink (bn, bv) until the kernel's VMEM footprint fits the budget.
+
+    The footprint scales with BOTH the model dim and the table dtype — a
+    [d, bv] float32 table tile is double-buffered on input AND (for the dw
+    kernel) on output, plus an f32 accumulator — so the defaults that fit
+    d=512 overflow at d=768 with an f32 table. Halving keeps tiles at lane
+    multiples; block size only changes tiling, not results (beyond fp
+    summation order).
+
+    Vocab blocks shrink first: halving bv keeps the total table traffic and
+    the row-block count (hence table passes) unchanged, while halving bn
+    doubles the fwd/dh kernels' full-table re-streams — measured 15% slower
+    on the 793k-vocab full-softmax when bn gives way first."""
+    def need(bn_, bv_):
+        h_tiles = 2 * bn_ * d * h_size
+        w_tiles = 2 * d * bv_ * w_size
+        if dw_kernel:  # + double-buffered dw output tile + f32 accumulator
+            return h_tiles + w_tiles + 2 * d * bv_ * w_size + 4 * d * bv_
+        # fwd/dh: + output [bn, d] tile + f32 accumulator (dh) / lse scratch
+        return h_tiles + w_tiles + 2 * bn_ * d * h_size + 4 * bn_ * d
+    while bv > _LANES and need(bn, bv) > _VMEM_BUDGET:
+        bv //= 2
+    while bn > _LANES and need(bn, bv) > _VMEM_BUDGET:
+        bn //= 2
+    if need(bn, bv) > _VMEM_BUDGET:
+        # Refusing beats proceeding: over budget, the Mosaic backend can die
+        # mid-compile with an unactionable tunnel error instead of raising.
+        raise ValueError(
+            f"fused_softmax_xent: even the minimum ({_LANES}, {_LANES}) tiling "
+            f"needs {need(bn, bv) / 2**20:.1f} MiB of VMEM (budget "
+            f"{_VMEM_BUDGET / 2**20:.0f} MiB) at d={d} with a "
+            f"{w_size}-byte table dtype; use a smaller model dim, a bf16 "
+            f"table, or the XLA head (fused_head=False)")
+    return bn, bv
+
+
 def _w_spec(d, bv, w_vd, index2):
     """BlockSpec for one vocab tile of w in its stored layout. ``index2`` maps
     grid coords to the vocab-block index."""
@@ -125,6 +171,8 @@ def _w_spec(d, bv, w_vd, index2):
 
 
 def _forward(h, w, b, bn, bv, interpret, w_vd):
+    bn, bv = _fit_blocks(h.shape[1], bn, bv, h.dtype.itemsize,
+                         w.dtype.itemsize, dw_kernel=False)
     n, d, v, n_n, n_v = _shapes(h, w, bn, bv, w_vd)
     lse = pl.pallas_call(
         functools.partial(_fwd_kernel, n_v=n_v, w_vd=w_vd, bv=bv, v=v),
@@ -210,6 +258,8 @@ def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
 
 
 def _backward(h, w, b, lse, g, bn, bv, interpret, w_vd):
+    bn, bv = _fit_blocks(h.shape[1], bn, bv, h.dtype.itemsize,
+                         w.dtype.itemsize, dw_kernel=True)
     n, d, v, n_n, n_v = _shapes(h, w, bn, bv, w_vd)
     bvec = b.reshape(1, -1)
     # The lse/g planes are tiny [N] vectors; padding THEM is cheap (unlike the

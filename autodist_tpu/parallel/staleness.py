@@ -312,6 +312,7 @@ class AsyncPSRunner(DistributedRunner):
         # the (jitted) sync step_fn, so compile it here.
         self._jit_grad_fn = jax.jit(self._grad_fn)
         self._workers = {i: AsyncWorker(self, i) for i in range(self.num_workers)}
+        self._membership_lock = threading.Lock()  # add_worker bookkeeping
         self._dump_lock = threading.Lock()
         self._dumped = False
         self._placer = None
@@ -411,13 +412,17 @@ class AsyncPSRunner(DistributedRunner):
         Returns its handle; the gate seeds its step count at the slowest live
         worker's (see :meth:`StalenessController.register`). The reference
         could only fail-fast on worker loss (``coordinator.py:98-110``); the
-        retire + register pair makes membership elastic."""
+        retire + register pair makes membership elastic.
+
+        Thread-safe: the PS transport calls this from per-connection handler
+        threads (two remote workers may register simultaneously)."""
         if self.service is None:
             raise RuntimeError("Call init(params) before creating workers")
         wid = self.controller.register(worker_id)
-        self.num_workers = max(self.num_workers, wid + 1)
-        if wid not in self._workers:
-            self._workers[wid] = AsyncWorker(self, wid)
+        with self._membership_lock:
+            self.num_workers = max(self.num_workers, wid + 1)
+            if wid not in self._workers:
+                self._workers[wid] = AsyncWorker(self, wid)
         logging.info("AsyncPSRunner: admitted worker %d (gate now %d slots)",
                      wid, len(self.controller.steps))
         return self._workers[wid]

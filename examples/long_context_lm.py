@@ -62,6 +62,10 @@ def main(argv=None):
     else:
         attention = args.attention
     if args.seq_axis > 1:
+        if args.attention not in ("auto",):
+            parser.error(f"--seq_axis {args.seq_axis} shards the sequence and "
+                         f"runs ring attention across shards; it cannot honor "
+                         f"--attention {args.attention} (drop the flag)")
         attention = "ring"
 
     # Default batch: keep ~393k tokens in flight (the flagship bench's 384*256*4)

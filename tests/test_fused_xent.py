@@ -65,6 +65,47 @@ def test_grads_match_reference_f32():
         np.testing.assert_allclose(a, e, rtol=2e-4, atol=2e-5)
 
 
+def test_fit_blocks_shrinks_for_large_d_f32_table():
+    """The default (bn=512, bv=1024) tiles fit d=512 but overflow VMEM at
+    d=768 with an f32 table — the dw kernel double-buffers both the table
+    tile and the dw output tile, plus an f32 accumulator. The fitter must
+    shrink bv at d=768 (the Mosaic backend dies mid-compile on overflow
+    instead of failing cleanly) and leave the d=512 flagship tiling alone."""
+    from autodist_tpu.ops.fused_xent import _VMEM_BUDGET, _fit_blocks
+
+    # bf16 h (2 bytes), f32 table (4 bytes) — the model zoo's param_dtype.
+    assert _fit_blocks(512, 512, 1024, 2, 4, dw_kernel=True) == (512, 1024)
+    bn, bv = _fit_blocks(768, 512, 1024, 2, 4, dw_kernel=True)
+    assert bv < 1024
+    need = (2 * bn * 768 * 2) + (4 * 768 * bv * 4) + (4 * 768 * bv)
+    assert need <= _VMEM_BUDGET
+    # d=1024 shrinks further but never below one lane tile.
+    bn2, bv2 = _fit_blocks(1024, 512, 1024, 2, 4, dw_kernel=True)
+    assert 128 <= bv2 <= bv
+    # A dim no tiling can fit refuses with an actionable error instead of
+    # letting the Mosaic backend die mid-compile.
+    with pytest.raises(ValueError, match="VMEM"):
+        _fit_blocks(16384, 512, 1024, 4, 4, dw_kernel=True)
+
+
+def test_shrunken_blocks_stay_value_exact(monkeypatch):
+    """Force the fitter to shrink at small shapes (tiny budget) and check the
+    kernel still matches the XLA reference — block size must only change
+    tiling, never values."""
+    from autodist_tpu.ops import fused_xent as fx
+
+    monkeypatch.setattr(fx, "_VMEM_BUDGET", 256 << 10)
+    h, w, b = _data(128, 64, 320, jnp.float32, seed=6)
+    got = fx.matmul_logsumexp(h, w, b, 64, 256)
+    np.testing.assert_allclose(got, _ref_lse(h, w, b), **_f32_tol())
+    gf = jax.grad(lambda h, w, b: jnp.sum(
+        fx.matmul_logsumexp(h, w, b, 64, 256) * 0.01), argnums=(0, 1, 2))(h, w, b)
+    gr = jax.grad(lambda h, w, b: jnp.sum(
+        _ref_lse(h, w, b) * 0.01), argnums=(0, 1, 2))(h, w, b)
+    for a, e in zip(gf, gr):
+        np.testing.assert_allclose(a, e, **_f32_tol(rtol=2e-4, atol=2e-5))
+
+
 def test_grads_bf16_track_f32():
     h, w, b = _data(128, 64, 256, jnp.bfloat16, seed=4)
 
