@@ -118,21 +118,26 @@ def _shapes(h, w, bn, bv, w_vd: bool):
     return n, d, v, pl.cdiv(n, bn), pl.cdiv(v, bv)
 
 
-# Per-core VMEM the kernels may plan against (v5e has 16 MiB; leave headroom
-# for the compiler's own buffers). Exceeding it does not fail cleanly — the
-# Mosaic backend can die mid-compile — so block sizes are fitted up front.
-_VMEM_BUDGET = 14 << 20
+# Per-core VMEM the kernels may plan against (v5e has 16 MiB; ~1 MiB headroom
+# for the compiler's own buffers — the estimates below match Mosaic's measured
+# scoped allocations within ~0.2 MiB). Exceeding the physical limit does not
+# fail cleanly — the Mosaic backend can die mid-compile — so block sizes are
+# fitted up front.
+_VMEM_BUDGET = 15 << 20
 
 
-def _fit_blocks(d: int, bn: int, bv: int, h_size: int, w_size: int,
-                dw_kernel: bool):
-    """Shrink (bn, bv) until the kernel's VMEM footprint fits the budget.
+def _fit_blocks(d: int, n: int, bn: int, bv: int, h_size: int, w_size: int,
+                backward: bool):
+    """Shrink (bn, bv) until every kernel launched with them fits the budget.
 
     The footprint scales with BOTH the model dim and the table dtype — a
     [d, bv] float32 table tile is double-buffered on input AND (for the dw
     kernel) on output, plus an f32 accumulator — so the defaults that fit
-    d=512 overflow at d=768 with an f32 table. Halving keeps tiles at lane
-    multiples; block size only changes tiling, not results (beyond fp
+    d=512 overflow at d=768 with an f32 table. The backward pass launches TWO
+    kernels (dh and dw/db) with the same blocks, so it budgets against the
+    max of both footprints, plus the fully-resident [n_n, bn] lse/g planes
+    (whole-array BlockSpecs, ~4 bytes per padded row each). Halving clamps at
+    one lane tile; block size only changes tiling, not results (beyond fp
     summation order).
 
     Vocab blocks shrink first: halving bv keeps the total table traffic and
@@ -140,21 +145,26 @@ def _fit_blocks(d: int, bn: int, bv: int, h_size: int, w_size: int,
     doubles the fwd/dh kernels' full-table re-streams — measured 15% slower
     on the 793k-vocab full-softmax when bn gives way first."""
     def need(bn_, bv_):
+        n_pad = -(-n // bn_) * bn_
+        planes = (2 if backward else 1) * 4 * n_pad  # lse (+ g) resident f32
         h_tiles = 2 * bn_ * d * h_size
         w_tiles = 2 * d * bv_ * w_size
-        if dw_kernel:  # + double-buffered dw output tile + f32 accumulator
-            return h_tiles + w_tiles + 2 * d * bv_ * w_size + 4 * d * bv_
-        # fwd/dh: + output [bn, d] tile + f32 accumulator (dh) / lse scratch
-        return h_tiles + w_tiles + 2 * bn_ * d * h_size + 4 * bn_ * d
+        # fwd/dh shape: + output [bn, d] tile + f32 [bn, d] accumulator (the
+        # fwd kernel's (bn, LANES) scratch is strictly smaller: conservative).
+        row_kernel = h_tiles + w_tiles + 2 * bn_ * d * h_size + 4 * bn_ * d
+        if not backward:
+            return row_kernel + planes
+        dw_kernel = h_tiles + w_tiles + 2 * d * bv_ * w_size + 4 * d * bv_
+        return max(row_kernel, dw_kernel) + planes
     while bv > _LANES and need(bn, bv) > _VMEM_BUDGET:
-        bv //= 2
+        bv = max(_LANES, bv // 2)
     while bn > _LANES and need(bn, bv) > _VMEM_BUDGET:
-        bn //= 2
+        bn = max(_LANES, bn // 2)
     if need(bn, bv) > _VMEM_BUDGET:
         # Refusing beats proceeding: over budget, the Mosaic backend can die
         # mid-compile with an unactionable tunnel error instead of raising.
         raise ValueError(
-            f"fused_softmax_xent: even the minimum ({_LANES}, {_LANES}) tiling "
+            f"fused_softmax_xent: even the minimum ({bn}, {bv}) tiling "
             f"needs {need(bn, bv) / 2**20:.1f} MiB of VMEM (budget "
             f"{_VMEM_BUDGET / 2**20:.0f} MiB) at d={d} with a "
             f"{w_size}-byte table dtype; use a smaller model dim, a bf16 "
@@ -171,8 +181,8 @@ def _w_spec(d, bv, w_vd, index2):
 
 
 def _forward(h, w, b, bn, bv, interpret, w_vd):
-    bn, bv = _fit_blocks(h.shape[1], bn, bv, h.dtype.itemsize,
-                         w.dtype.itemsize, dw_kernel=False)
+    bn, bv = _fit_blocks(h.shape[1], h.shape[0], bn, bv, h.dtype.itemsize,
+                         w.dtype.itemsize, backward=False)
     n, d, v, n_n, n_v = _shapes(h, w, bn, bv, w_vd)
     lse = pl.pallas_call(
         functools.partial(_fwd_kernel, n_v=n_v, w_vd=w_vd, bv=bv, v=v),
@@ -258,8 +268,8 @@ def _dwdb_kernel(h_ref, w_ref, b_ref, lse_ref, g_ref, dw_ref, db_ref,
 
 
 def _backward(h, w, b, lse, g, bn, bv, interpret, w_vd):
-    bn, bv = _fit_blocks(h.shape[1], bn, bv, h.dtype.itemsize,
-                         w.dtype.itemsize, dw_kernel=True)
+    bn, bv = _fit_blocks(h.shape[1], h.shape[0], bn, bv, h.dtype.itemsize,
+                         w.dtype.itemsize, backward=True)
     n, d, v, n_n, n_v = _shapes(h, w, bn, bv, w_vd)
     bvec = b.reshape(1, -1)
     # The lse/g planes are tiny [N] vectors; padding THEM is cheap (unlike the

@@ -62,11 +62,20 @@ CONFIGS = {
 }
 
 
-def run_config(name: str, steps: str):
+def run_config(name: str, steps: str, attempts: int = 2):
     builder, unit, pattern = CONFIGS[name]
     cmd = [sys.executable] + builder(steps)
-    proc = subprocess.run(cmd, capture_output=True, text=True)
-    out = proc.stdout + proc.stderr
+    for attempt in range(attempts):
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        out = proc.stdout + proc.stderr
+        if proc.returncode == 0:
+            break
+        # Transient platform failures (HBM-margin OOM right after another
+        # config's process released memory, compile-tunnel hiccups) deserve
+        # one retry before the row reads FAILED.
+        if attempt < attempts - 1:
+            print(f"  {name}: attempt {attempt + 1} failed, retrying ...",
+                  flush=True)
     if proc.returncode != 0:
         return {"name": name, "unit": unit, "rate": None, "mfu_pct": None,
                 "error": out.strip().splitlines()[-1] if out.strip() else "failed"}

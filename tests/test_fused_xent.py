@@ -73,19 +73,29 @@ def test_fit_blocks_shrinks_for_large_d_f32_table():
     instead of failing cleanly) and leave the d=512 flagship tiling alone."""
     from autodist_tpu.ops.fused_xent import _VMEM_BUDGET, _fit_blocks
 
+    N = 98304  # flagship: 384 * 256 tokens
     # bf16 h (2 bytes), f32 table (4 bytes) — the model zoo's param_dtype.
-    assert _fit_blocks(512, 512, 1024, 2, 4, dw_kernel=True) == (512, 1024)
-    bn, bv = _fit_blocks(768, 512, 1024, 2, 4, dw_kernel=True)
+    assert _fit_blocks(512, N, 512, 1024, 2, 4, backward=True) == (512, 1024)
+    bn, bv = _fit_blocks(768, N, 512, 1024, 2, 4, backward=True)
     assert bv < 1024
-    need = (2 * bn * 768 * 2) + (4 * 768 * bv * 4) + (4 * 768 * bv)
-    assert need <= _VMEM_BUDGET
+    dw_need = (2 * bn * 768 * 2) + (4 * 768 * bv * 4) + (4 * 768 * bv)
+    assert dw_need <= _VMEM_BUDGET
     # d=1024 shrinks further but never below one lane tile.
-    bn2, bv2 = _fit_blocks(1024, 512, 1024, 2, 4, dw_kernel=True)
+    bn2, bv2 = _fit_blocks(1024, N, 512, 1024, 2, 4, backward=True)
     assert 128 <= bv2 <= bv
+    # The backward budget covers BOTH its kernels: the dh footprint at large d
+    # with f32 activations must also bound the result.
+    bn3, bv3 = _fit_blocks(2048, N, 512, 1024, 4, 4, backward=True)
+    dh_need = (2 * bn3 * 2048 * 4) * 2 + (2 * 2048 * bv3 * 4) + 4 * bn3 * 2048
+    assert dh_need <= _VMEM_BUDGET
+    # Odd lane multiples clamp at one lane tile, never below (192 -> 128,
+    # not 96).
+    bn4, bv4 = _fit_blocks(2048, N, 512, 192, 4, 4, backward=True)
+    assert bv4 == 128 and bn4 >= 128
     # A dim no tiling can fit refuses with an actionable error instead of
     # letting the Mosaic backend die mid-compile.
     with pytest.raises(ValueError, match="VMEM"):
-        _fit_blocks(16384, 512, 1024, 4, 4, dw_kernel=True)
+        _fit_blocks(32768, N, 512, 1024, 4, 4, backward=True)
 
 
 def test_shrunken_blocks_stay_value_exact(monkeypatch):
