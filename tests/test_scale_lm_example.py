@@ -1,0 +1,9 @@
+"""The decoder-scaling example (examples/scale_lm.py) runs on tiny shapes."""
+
+import examples.scale_lm as sl
+
+
+def test_scale_lm_example_runs():
+    rate = sl.main(["--d_model", "64", "--n_layers", "2", "--batch_size", "8",
+                    "--seq_len", "64", "--vocab", "256", "--steps", "2"])
+    assert rate > 0
