@@ -29,7 +29,10 @@ def main(argv=None):
                         help="'lstm' = the reference's exact model family "
                              "(LSTM + sampled softmax)")
     parser.add_argument("--steps", type=int, default=200)
-    parser.add_argument("--batch_size", type=int, default=128)  # v5e sweep at this config: ~214k wps at 128 vs ~88k at 32
+    # 0 = auto: 128 (v5e sweep: ~214k wps at 128 vs ~88k at 32), except 96
+    # for the giant-vocab full-softmax config, whose parameters + Adafactor
+    # state leave less HBM headroom (128 OOMs there).
+    parser.add_argument("--batch_size", type=int, default=0)
     parser.add_argument("--seq_len", type=int, default=256)
     parser.add_argument("--log_every", type=int, default=100)
     parser.add_argument("--d_model", type=int, default=512)
@@ -48,6 +51,12 @@ def main(argv=None):
     import jax
     on_accel = jax.default_backend() != "cpu"
     dtype = jnp.bfloat16 if on_accel else jnp.float32
+    # One predicate, two coupled decisions: the giant-vocab full-softmax run
+    # needs BOTH Adafactor (Adam's moments on ~4.9 GB of tables exceed HBM)
+    # and the smaller default batch (128 OOMs with the remaining headroom).
+    big_vocab = args.full_softmax and args.vocab > 100_000
+    if not args.batch_size:
+        args.batch_size = 96 if big_vocab else 128
 
     if args.model == "lstm":
         cfg = lstm_lm.LSTMLMConfig(
@@ -76,7 +85,6 @@ def main(argv=None):
     # activations) exceed one v5e's 16 GB HBM, so the giant-vocab config uses
     # Adafactor — the standard factored-second-moment choice for huge
     # embeddings (state ~= params instead of 3x params).
-    big_vocab = args.full_softmax and args.vocab > 100_000
     optimizer = (optax.adafactor(1e-3) if big_vocab else optax.adam(1e-3))
     step = ad.function(loss_fn, params, optimizer, example_batch=batch)
     # Keep the synthetic batch device-resident: re-shipping it from host
