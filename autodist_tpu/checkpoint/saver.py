@@ -47,6 +47,22 @@ def _scan_checkpoints(base: str):
     return sorted(found)
 
 
+def _read_recorded(save_path: str):
+    """The directory-level state file's recorded rotation list (``[]`` when
+    missing/corrupt) plus the regex matching THIS name's prefixes — the one
+    read/parse shared by rotation adoption and state-file rewriting, so the
+    two can never disagree about which entries belong to a name."""
+    state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
+    recorded = []
+    if os.path.exists(state_path):
+        try:
+            with open(state_path) as f:
+                recorded = json.load(f).get("all") or []
+        except (ValueError, OSError):
+            recorded = []
+    return state_path, recorded, re.compile(re.escape(save_path) + r"-\d+")
+
+
 def _flatten_named(tree: PyTree) -> Dict[str, np.ndarray]:
     """Flatten a pytree to {original-name: full host ndarray}.
 
@@ -154,15 +170,7 @@ class Saver:
             return
         self._rotation_loaded = True
         on_disk = [prefix for _, prefix in _scan_checkpoints(save_path)]
-        state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
-        recorded = []
-        if os.path.exists(state_path):
-            try:
-                with open(state_path) as f:
-                    recorded = json.load(f).get("all") or []
-            except (ValueError, OSError):
-                recorded = []
-        name_pat = re.compile(re.escape(save_path) + r"-\d+")
+        _, recorded, name_pat = _read_recorded(save_path)
         ours_recorded = {p for p in recorded if name_pat.fullmatch(p)}
         if ours_recorded:
             # A previous run of this name left its rotation list: honor it.
@@ -174,9 +182,17 @@ class Saver:
                 self._kept.append(prefix)
 
     def _update_state_file(self, save_path: str, prefix: str):
-        state_path = os.path.join(os.path.dirname(save_path) or ".", _STATE_FILE)
+        """Rewrite the shared ``checkpoint`` state file, merging per name: only
+        THIS name's entries are replaced by our rotation list. Two models
+        checkpointing into one directory keep independent rotation records —
+        the other name's entries survive, so its restarted Saver adopts its own
+        recorded list instead of falling back to a full scan (which could
+        rotate-delete a user-preserved ``<name>-<step>.npz``)."""
+        state_path, recorded, name_pat = _read_recorded(save_path)
+        others = [p for p in recorded
+                  if not name_pat.fullmatch(p) and p not in self._kept]
         with open(state_path, "w") as f:
-            json.dump({"latest": prefix, "all": list(self._kept)}, f)
+            json.dump({"latest": prefix, "all": others + list(self._kept)}, f)
 
     def _rotate(self, prefix: str):
         if prefix in self._kept:  # re-saving a step (e.g. checkpoint-on-resume)

@@ -154,7 +154,12 @@ def _fit_blocks(d: int, n: int, bn: int, bv: int, h_size: int, w_size: int,
         row_kernel = h_tiles + w_tiles + 2 * bn_ * d * h_size + 4 * bn_ * d
         if not backward:
             return row_kernel + planes
-        dw_kernel = h_tiles + w_tiles + 2 * d * bv_ * w_size + 4 * d * bv_
+        # dw output tile (double-buffered) + f32 dw accumulator + the
+        # [_LANES, bv] f32 db accumulator scratch + double-buffered (1, bv)
+        # db output tile — 512 KiB+ at the default bv, enough to push a
+        # just-under-budget fit over physical VMEM.
+        dw_kernel = (h_tiles + w_tiles + 2 * d * bv_ * w_size + 4 * d * bv_
+                     + 4 * _LANES * bv_ + 2 * bv_ * w_size)
         return max(row_kernel, dw_kernel) + planes
     while bv > _LANES and need(bn, bv) > _VMEM_BUDGET:
         bv = max(_LANES, bv // 2)

@@ -188,15 +188,31 @@ class PSServer:
                     while True:
                         msg, _ = _recv_msg(self.request)
                         reply = outer._dispatch(msg)
-                        if msg[0] in ("start_step", "finish_step"):
-                            self.worker_id = msg[1]
-                            self.worker_gen = controller.generation(msg[1])
+                        # The generation token rides in the dispatch reply,
+                        # read inside the controller's own critical section —
+                        # a separate generation() read here could race a
+                        # concurrent re-registration and adopt the REPLACEMENT
+                        # occupant's token (whose retire would then kill the
+                        # live worker when this connection dies).
+                        if msg[0] in ("start_step", "finish_step") \
+                                and reply[0] == "ok":
+                            # Capture ONCE, at the connection's first bind to
+                            # this worker id. Refreshing on every message would
+                            # let a zombie connection that sends one more gate
+                            # message AFTER a replacement re-registered the
+                            # slot adopt the new generation.
+                            if self.worker_id != msg[1]:
+                                self.worker_id = msg[1]
+                                self.worker_gen = reply[1]
                         elif msg[0] == "register" and reply[0] == "ok":
+                            # register DOES refresh: this connection's own
+                            # registration bumped the slot's generation, so the
+                            # old token is stale by construction.
                             # Covers a replacement that registers and dies
                             # before its first step (and worker_id=None
                             # allocations, whose id only the reply knows).
                             self.worker_id = reply[1]
-                            self.worker_gen = controller.generation(reply[1])
+                            self.worker_gen = reply[2]
                         _send_msg(self.request, reply)
                 except (ConnectionError, OSError):
                     # A vanished worker must not freeze the staleness gate for
@@ -235,8 +251,8 @@ class PSServer:
         try:
             if op == "start_step":
                 _, worker_id, timeout = msg
-                r.controller.start_step(worker_id, timeout)
-                return ("ok",)
+                gen = r.controller.start_step(worker_id, timeout)
+                return ("ok", gen)
             if op == "read":
                 params, ef_state, version = r.service.read()
                 return ("ok", _to_host(params), _to_host(ef_state), version)
@@ -249,12 +265,21 @@ class PSServer:
                 version = r.service.apply(msg[1])
                 return ("ok", version)
             if op == "finish_step":
-                r.controller.finish_step(msg[1])
-                return ("ok",)
+                gen = r.controller.finish_step(msg[1])
+                return ("ok", gen)
             if op == "register":
                 # Through add_worker, not the bare controller: the chief-side
                 # runner's num_workers / handle table must track the gate.
-                return ("ok", r.add_worker(msg[1]).worker_id)
+                # Holding the controller's (reentrant) condition lock across
+                # the call makes the id+generation pair atomic: without it, a
+                # near-simultaneous second registration could bump the
+                # generation between our register and our read, and THIS
+                # connection would adopt — and on death retire — the live
+                # occupant's token. Lock order (_cond → _membership_lock)
+                # matches add_worker's internal order, so no inversion.
+                with r.controller._cond:
+                    wid = r.add_worker(msg[1]).worker_id
+                    return ("ok", wid, r.controller._generation.get(wid, 0))
             if op == "version":
                 return ("ok", r.service.version)
             return ("error", "PSClientError", f"unknown op {op!r}")
@@ -328,6 +353,13 @@ class RemotePSWorker:
         self.worker_id = worker_id
         self.steps_completed = 0
         self.last_version_read = -1
+        # Register up front: idempotent for a live slot (the server keeps its
+        # count), and for a RETIRED slot — e.g. a Coordinator-relaunched worker
+        # reusing its AUTODIST_PROCESS_ID — it re-admits the slot so stepping
+        # is gated again. Without this, a relaunched process would step a
+        # retired slot the live workers no longer wait for, silently making
+        # the staleness bound one-sided.
+        self.register()
         # Cache of the last pulled (params, ef_state): the conditional pull in
         # step() reuses it when the service version is unchanged, so a worker
         # whose gate opened with no intervening applies ships no parameter
@@ -345,7 +377,7 @@ class RemotePSWorker:
         rejoin for a replacement process after the original disconnected and
         was retired. Seeds the gate at the slowest live worker's step count;
         returns the admitted id (may differ when ``worker_id`` was None)."""
-        (wid,) = self._client.call("register", self.worker_id)
+        wid = self._client.call("register", self.worker_id)[0]
         self.worker_id = wid
         return wid
 

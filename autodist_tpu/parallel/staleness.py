@@ -137,8 +137,13 @@ class StalenessController:
             self._cond.notify_all()
             return worker_id
 
-    def start_step(self, worker_id: int, timeout: Optional[float] = None):
+    def start_step(self, worker_id: int, timeout: Optional[float] = None) -> int:
         """Block until the worker is within the staleness bound.
+
+        Returns the slot's occupancy generation, read under the SAME lock that
+        admitted the step — the PS transport binds a connection's retire token
+        to it, and a read outside this critical section could race a concurrent
+        re-registration and hand back the replacement's token.
 
         Raises :class:`StalenessTimeout` if the bound does not open in ``timeout``
         seconds (the reference's queue dequeue blocked forever; a timeout keeps the
@@ -149,11 +154,15 @@ class StalenessController:
                 raise StalenessTimeout(
                     f"worker {worker_id} at step {self._steps[worker_id]} still "
                     f">= {self._bound} ahead of the slowest worker after {timeout}s")
+            return self._generation.get(worker_id, 0)
 
-    def finish_step(self, worker_id: int):
+    def finish_step(self, worker_id: int) -> int:
+        """Advance the worker's completed-step count; returns the slot's
+        occupancy generation (same atomicity rationale as :meth:`start_step`)."""
         with self._cond:
             self._steps[worker_id] += 1
             self._cond.notify_all()
+            return self._generation.get(worker_id, 0)
 
 
 class ParameterService:

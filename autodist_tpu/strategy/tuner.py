@@ -111,10 +111,23 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
             "measurement that ignores the cross-node wire. Tune with a "
             "single-node spec, or benchmark multi-node candidates through a "
             "real cluster launch (examples/benchmark)")
-    accum_sweep = ([accumulation_steps] if isinstance(accumulation_steps, int)
-                   else list(accumulation_steps))
-    if not accum_sweep or any(a < 1 for a in accum_sweep):
-        raise ValueError("accumulation_steps must be >= 1 (int or sequence)")
+    # bool is an int subclass: True would silently sweep [True]; reject it.
+    # numbers.Integral (rather than int) admits numpy integer sweeps like
+    # np.arange(1, 5); values are normalized to plain int below.
+    import numbers
+    if isinstance(accumulation_steps, bool):
+        raise TypeError("accumulation_steps must be an int or a sequence of "
+                        "ints, not a bool")
+    accum_sweep = ([accumulation_steps]
+                   if isinstance(accumulation_steps, numbers.Integral)
+                   else tuple(accumulation_steps))  # materialize generators
+    if not accum_sweep or any(isinstance(a, bool)
+                              or not isinstance(a, numbers.Integral)
+                              or a < 1 for a in accum_sweep):
+        raise ValueError(
+            f"accumulation_steps must be an int >= 1 or a non-empty sequence "
+            f"of such ints, got {accumulation_steps!r}")
+    accum_sweep = [int(a) for a in accum_sweep]
     if candidates is None:
         spec = (ModelSpec(params, sparse_names=sparse_names)
                 if sparse_names is not None
