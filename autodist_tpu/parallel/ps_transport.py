@@ -270,16 +270,10 @@ class PSServer:
             if op == "register":
                 # Through add_worker, not the bare controller: the chief-side
                 # runner's num_workers / handle table must track the gate.
-                # Holding the controller's (reentrant) condition lock across
-                # the call makes the id+generation pair atomic: without it, a
-                # near-simultaneous second registration could bump the
-                # generation between our register and our read, and THIS
-                # connection would adopt — and on death retire — the live
-                # occupant's token. Lock order (_cond → _membership_lock)
-                # matches add_worker's internal order, so no inversion.
-                with r.controller._cond:
-                    wid = r.add_worker(msg[1]).worker_id
-                    return ("ok", wid, r.controller._generation.get(wid, 0))
+                # with_generation captures the retire token atomically with
+                # the registration (see register_with_generation).
+                worker, gen = r.add_worker(msg[1], with_generation=True)
+                return ("ok", worker.worker_id, gen)
             if op == "version":
                 return ("ok", r.service.version)
             return ("error", "PSClientError", f"unknown op {op!r}")

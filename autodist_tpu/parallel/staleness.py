@@ -137,6 +137,18 @@ class StalenessController:
             self._cond.notify_all()
             return worker_id
 
+    def register_with_generation(self, worker_id: Optional[int] = None):
+        """:meth:`register` plus the slot's resulting occupancy generation,
+        read in the SAME critical section (``Condition()`` is RLock-backed, so
+        the nested acquire is safe). The transport binds a connection's retire
+        token to this pair; two separate calls would let a near-simultaneous
+        second registration bump the generation in between, handing this
+        caller the LIVE occupant's token — whose eventual stale retire would
+        kill the live worker."""
+        with self._cond:
+            wid = self.register(worker_id)
+            return wid, self._generation.get(wid, 0)
+
     def start_step(self, worker_id: int, timeout: Optional[float] = None) -> int:
         """Block until the worker is within the staleness bound.
 
@@ -415,7 +427,8 @@ class AsyncPSRunner(DistributedRunner):
                 f"admit it")
         return self._workers[worker_id]
 
-    def add_worker(self, worker_id: Optional[int] = None) -> AsyncWorker:
+    def add_worker(self, worker_id: Optional[int] = None,
+                   with_generation: bool = False):
         """Elastically (re-)admit a worker slot mid-run: a replacement for a
         retired (crashed) worker, or a brand-new slot (``worker_id=None``).
         Returns its handle; the gate seeds its step count at the slowest live
@@ -423,17 +436,23 @@ class AsyncPSRunner(DistributedRunner):
         could only fail-fast on worker loss (``coordinator.py:98-110``); the
         retire + register pair makes membership elastic.
 
+        ``with_generation=True`` returns ``(handle, generation)`` where the
+        generation was captured atomically with the registration — the retire
+        token the PS transport binds to the admitting connection.
+
         Thread-safe: the PS transport calls this from per-connection handler
         threads (two remote workers may register simultaneously)."""
         if self.service is None:
             raise RuntimeError("Call init(params) before creating workers")
-        wid = self.controller.register(worker_id)
+        wid, gen = self.controller.register_with_generation(worker_id)
         with self._membership_lock:
             self.num_workers = max(self.num_workers, wid + 1)
             if wid not in self._workers:
                 self._workers[wid] = AsyncWorker(self, wid)
         logging.info("AsyncPSRunner: admitted worker %d (gate now %d slots)",
                      wid, len(self.controller.steps))
+        if with_generation:
+            return self._workers[wid], gen
         return self._workers[wid]
 
     def _place(self, state: TrainState) -> TrainState:

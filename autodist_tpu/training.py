@@ -40,9 +40,12 @@ def train(runner, params: PyTree,
     """Run ``steps`` global steps, checkpointing and resuming automatically.
 
     ``batches``: either ``fn(step_index) -> batch`` or an iterable of batches
-    (exhaustion ends the run early). ``save_every``/final saves happen on the
-    chief only (every process restores, so all resume in lockstep — the c10
-    shared-filesystem protocol). ``on_metrics(step, loss, rate)`` fires every
+    (exhaustion ends the run early). In a multi-process SPMD program
+    (``jax.process_count() > 1``) saves are COLLECTIVE: every process calls
+    :meth:`Saver.save` at the same step, writes the state shards it owns, and
+    only the chief publishes the manifest + rotation — the c10
+    shared-filesystem protocol against cross-process-sharded state. With one
+    process (or async-PS worker roles), saves stay chief-only. ``on_metrics(step, loss, rate)`` fires every
     ``log_every`` steps. With ``eval_every`` and ``eval_batch``, the runner's
     forward-only :meth:`evaluate` runs every ``eval_every`` steps on the
     current params (``eval_fn`` defaults to the loss) and ``on_eval(step,
@@ -52,6 +55,12 @@ def train(runner, params: PyTree,
         raise ValueError("eval_every needs an eval_batch")
     if is_chief is None:
         is_chief = const.is_chief_process()
+    # Sharded (multi-process SPMD) saves are collective: every process must
+    # participate — each writes the shards it owns; the Saver itself gates
+    # manifest/rotation to process 0. Chief-only gating remains for
+    # single-process programs (incl. async-PS roles, where each process is
+    # its own jax program).
+    save_participant = is_chief or jax.process_count() > 1
     saver = Saver(max_to_keep=max_to_keep) if checkpoint_dir else None
     prefix_base = f"{checkpoint_dir}/{checkpoint_name}" if checkpoint_dir else None
 
@@ -125,10 +134,10 @@ def train(runner, params: PyTree,
                 logging.info("train: step %d eval (pytree)", step_i + 1)
             if on_eval is not None:
                 on_eval(step_i + 1, val)
-        if (saver is not None and is_chief and save_every
+        if (saver is not None and save_participant and save_every
                 and (step_i + 1) % save_every == 0 and step_i + 1 < steps):
             saver.save(state, prefix_base, runner=runner)
 
-    if saver is not None and is_chief and int(state.step) > start:
+    if saver is not None and save_participant and int(state.step) > start:
         saver.save(state, prefix_base, runner=runner)
     return state

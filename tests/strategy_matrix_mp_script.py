@@ -25,6 +25,20 @@ Each config runs 3 steps through the public API. Two modes, selected by env
 The chief writes final logical params, per-step losses, and physical-sharding
 evidence (shard shapes, padded storage shapes, sparse-wire/EF flags) to the
 JSON path in argv[1]; argv[2] picks the config.
+
+An optional argv[3] phase drives the checkpoint legs (the reference's c10
+2-node NFS saver contract, ``tests/integration/cases/c10.py:1-12``, against
+cross-process-sharded state). ``AUTODIST_MATRIX_CKPT_DIR`` names the shared
+checkpoint directory:
+
+- ``ckpt_save``     — steps 0..2, then every process calls ``Saver.save``
+                      (collective sharded save) and the program EXITS (the kill).
+- ``ckpt_restore``  — a fresh 2-process program restores the latest checkpoint
+                      (each process placing its own shards) and continues
+                      steps 3..4.
+- ``straight``      — 5 uninterrupted steps (the value-exact reference).
+- ``train_save`` / ``train_resume`` — same protocol driven entirely through
+  ``training.train`` (collective save + automatic resume inside the loop).
 """
 
 import json
@@ -48,6 +62,7 @@ from autodist_tpu.strategy import (AllReduce, PS, Parallax,  # noqa: E402
 BATCH = 16
 LR = 0.05
 STEPS = 3
+STEPS_TOTAL = 5   # checkpoint legs: save after 3, continue to 5
 VOCAB, DIM = 33, 4
 
 SINGLE = os.environ.get("AUTODIST_MATRIX_SINGLE") == "1"
@@ -91,10 +106,11 @@ CONFIGS = {
     # PS/ZeRO: full weight-update sharding; Adam states shard along reduce.
     "ps": dict(builder=lambda: PS(), mesh=None,
                optimizer=lambda: optax.adam(1e-2)),
-    # Model-axis storage with a padded-uneven param (7 -> 8 over 2 shards).
+    # Model-axis storage with a padded-uneven param (7 -> 8 over 2 shards);
+    # Adam, so the moments live padded + model-sharded across processes too.
     "partitioned": dict(builder=lambda: UnevenPartitionedPS(),
                         mesh={"model": 2, "data": -1},
-                        optimizer=lambda: optax.sgd(LR)),
+                        optimizer=lambda: optax.adam(1e-2)),
     # Explicit shard_map lowering: sparse wire + BF16_EF on dense grads.
     "parallax": dict(
         builder=lambda: Parallax(compressor="HorovodCompressorEF"),
@@ -143,7 +159,7 @@ def _shard_evidence(state, runner):
     return ev
 
 
-def main(out_path: str, config: str):
+def main(out_path: str, config: str, phase: str = ""):
     cfg = CONFIGS[config]
     ad = AutoDist(_spec(cfg["mesh"]), cfg["builder"]())
     params = make_params()
@@ -153,30 +169,74 @@ def main(out_path: str, config: str):
         assert jax.process_count() == 2, f"process_count={jax.process_count()}"
     assert jax.device_count() == 4, f"device_count={jax.device_count()}"
 
-    state = runner.init(params)
+    ckpt_dir = os.environ.get("AUTODIST_MATRIX_CKPT_DIR")
+
+    if phase in ("train_save", "train_resume"):
+        # The whole c10 protocol driven through training.train: collective
+        # sharded saves inside the loop, automatic latest-checkpoint resume.
+        from autodist_tpu.training import train
+        steps = STEPS if phase == "train_save" else STEPS_TOTAL
+        state = train(runner, params, make_batch, steps=steps,
+                      checkpoint_dir=ckpt_dir, checkpoint_name="trainloop",
+                      save_every=10_000, log_every=0)
+        if phase == "train_resume":
+            assert int(state.step) == STEPS_TOTAL, int(state.step)
+        _write_result(out_path, config, runner, state, losses=[],
+                      extra={"step": int(state.step),
+                             "ckpt_files": _ckpt_listing(ckpt_dir)})
+        return
+
+    from autodist_tpu.checkpoint.saver import Saver
+    if phase == "ckpt_restore":
+        latest = Saver.latest_checkpoint(ckpt_dir, name="model")
+        assert latest is not None, f"no checkpoint under {ckpt_dir}"
+        state = Saver().restore(latest, runner=runner)
+        assert int(state.step) == STEPS, int(state.step)
+        lo, hi = STEPS, STEPS_TOTAL
+    else:
+        state = runner.init(params)
+        lo, hi = 0, (STEPS_TOTAL if phase == "straight" else STEPS)
+
     evidence = _shard_evidence(state, runner)
     losses = []
-    for step in range(STEPS):
+    for step in range(lo, hi):
         state, loss = runner.run(state, make_batch(step))
         losses.append(float(loss))
 
-    if jax.process_index() == 0:
-        logical = jax.device_get(runner.logical_params(state))
-        result = {
-            "config": config,
-            "losses": losses,
-            "params": {k: np.asarray(v).tolist() for k, v in logical.items()},
-            "process_count": jax.process_count(),
-            "device_count": jax.device_count(),
-            "mesh": {k: int(v) for k, v in dict(runner.mesh.shape).items()},
-            **evidence,
-        }
-        with open(out_path, "w") as f:
-            json.dump(result, f)
+    if phase == "ckpt_save":
+        # COLLECTIVE: every process writes the state shards it owns; the chief
+        # publishes the manifest. The program exits right after — the "kill".
+        Saver().save(state, os.path.join(ckpt_dir, "model"), runner=runner)
+        evidence["ckpt_files"] = _ckpt_listing(ckpt_dir)
+
+    _write_result(out_path, config, runner, state, losses, extra=evidence)
+
+
+def _ckpt_listing(ckpt_dir):
+    if jax.process_index() != 0:
+        return []
+    return sorted(os.listdir(ckpt_dir))
+
+
+def _write_result(out_path, config, runner, state, losses, extra):
+    if jax.process_index() != 0:
+        return
+    logical = jax.device_get(runner.logical_params(state))
+    result = {
+        "config": config,
+        "losses": losses,
+        "params": {k: np.asarray(v).tolist() for k, v in logical.items()},
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "mesh": {k: int(v) for k, v in dict(runner.mesh.shape).items()},
+        **extra,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
 
 
 def run_single_reference(out_path: str, config: str, workdir: str,
-                         timeout: int = 300):
+                         timeout: int = 300, phase: str = ""):
     """Run this script once, single-process, on a 4-device sim mesh."""
     import subprocess
 
@@ -192,10 +252,12 @@ def run_single_reference(out_path: str, config: str, workdir: str,
         "AUTODIST_MATRIX_SINGLE": "1",
         "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
     })
-    return subprocess.run(
-        [sys.executable, os.path.abspath(__file__), out_path, config],
-        env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
+    args = [sys.executable, os.path.abspath(__file__), out_path, config]
+    if phase:
+        args.append(phase)
+    return subprocess.run(args, env=env, cwd=repo_root, capture_output=True,
+                          text=True, timeout=timeout)
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], sys.argv[2])
+    main(sys.argv[1], sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "")

@@ -210,6 +210,134 @@ def test_cross_process_powersgd(tmp_path):
     assert two["ef_params_dp"] == []  # PowerSGDState, not EFState, carries EF
 
 
+def _run_matrix_ckpt(tmp_path, monkeypatch, config):
+    """The reference c10 contract against cross-process-sharded state: a
+    2-process run saves (collective sharded write), DIES, a fresh 2-process
+    run restores and continues — and the stitched trajectory must match an
+    uninterrupted single-process run value-exactly."""
+    import os
+
+    import tests.strategy_matrix_mp_script as matrix
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "strategy_matrix_mp_script.py")
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    monkeypatch.setenv("AUTODIST_MATRIX_CKPT_DIR", str(ckpt_dir))
+
+    straight_out = tmp_path / "straight.json"
+    proc = matrix.run_single_reference(str(straight_out), config,
+                                       str(tmp_path / "wd_straight"),
+                                       phase="straight")
+    assert proc.returncode == 0, (
+        f"straight reference failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+    save_out = tmp_path / "save.json"
+    proc = mp_script.run_two_process_chief(
+        str(save_out), str(tmp_path / "wd_save"), script=script,
+        extra_args=(config, "ckpt_save"))
+    assert proc.returncode == 0, (
+        f"2-process save phase failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+    restore_out = tmp_path / "restore.json"
+    proc = mp_script.run_two_process_chief(
+        str(restore_out), str(tmp_path / "wd_restore"), script=script,
+        extra_args=(config, "ckpt_restore"))
+    assert proc.returncode == 0, (
+        f"2-process restore phase failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+    straight = json.loads(straight_out.read_text())
+    saved = json.loads(save_out.read_text())
+    restored = json.loads(restore_out.read_text())
+    assert saved["process_count"] == 2 and restored["process_count"] == 2
+
+    # The checkpoint is in the sharded format (per-process shard files +
+    # manifest) and no monolithic <name>-<step>.npz was ever assembled.
+    # Whether BOTH processes wrote depends on the config's layout (ownership
+    # dedups replicas to the lowest device id): the ZeRO test asserts it.
+    files = saved["ckpt_files"]
+    assert any(".shard00000-of-00002" in f for f in files), files
+    assert any(f == "model-3.json" for f in files), files
+    assert not any(f.endswith(".npz") and ".shard" not in f for f in files), files
+
+    # Stitched = straight, value-exact: losses before the kill, losses after
+    # the restore, and the final logical params.
+    np.testing.assert_allclose(saved["losses"],
+                               straight["losses"][:matrix.STEPS],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(restored["losses"],
+                               straight["losses"][matrix.STEPS:],
+                               rtol=1e-5, atol=1e-6)
+    for k in straight["params"]:
+        np.testing.assert_allclose(restored["params"][k], straight["params"][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    return saved, restored
+
+
+def test_cross_process_checkpoint_zero_opt_state(tmp_path, monkeypatch):
+    """Save/kill/restore/continue with Adam moments physically sharded along
+    the process-spanning reduce axis (the state device_get cannot assemble)."""
+    saved, restored = _run_matrix_ckpt(tmp_path, monkeypatch, "ps")
+    # The restored run re-sharded the moments across processes again.
+    assert restored["w2_opt_shard_shapes"] == [[1, 4]]
+    # ZeRO moments span the process boundary, so BOTH processes wrote shards.
+    assert any(".shard00001-of-00002" in f for f in saved["ckpt_files"]), \
+        saved["ckpt_files"]
+
+
+def test_cross_process_checkpoint_padded_uneven(tmp_path, monkeypatch):
+    """Save/kill/restore/continue with the 7-row padded-to-8 parameter (and
+    its Adam moments) stored model-sharded across both processes; the
+    checkpoint itself holds logical (unpadded) shapes."""
+    saved, restored = _run_matrix_ckpt(tmp_path, monkeypatch, "partitioned")
+    assert restored["wu_storage_shape"] == [8, 4]
+    assert restored["wu_shard_shapes"] == [[4, 4]]
+
+
+def test_cross_process_train_loop_checkpoint_resume(tmp_path, monkeypatch):
+    """training.train's own save path inside a real 2-process run: collective
+    final save, then a fresh 2-process train() resumes from the latest
+    checkpoint automatically and finishes — params exactly match an
+    uninterrupted single-process straight run."""
+    import os
+
+    import tests.strategy_matrix_mp_script as matrix
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "strategy_matrix_mp_script.py")
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    monkeypatch.setenv("AUTODIST_MATRIX_CKPT_DIR", str(ckpt_dir))
+
+    straight_out = tmp_path / "straight.json"
+    proc = matrix.run_single_reference(str(straight_out), "ps",
+                                       str(tmp_path / "wd_straight"),
+                                       phase="straight")
+    assert proc.returncode == 0, proc.stderr
+
+    for phase, out in (("train_save", tmp_path / "a.json"),
+                       ("train_resume", tmp_path / "b.json")):
+        proc = mp_script.run_two_process_chief(
+            str(out), str(tmp_path / f"wd_{phase}"), script=script,
+            extra_args=("ps", phase))
+        assert proc.returncode == 0, (
+            f"{phase} failed (rc={proc.returncode})\n"
+            f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+    straight = json.loads(straight_out.read_text())
+    resumed = json.loads((tmp_path / "b.json").read_text())
+    assert resumed["step"] == matrix.STEPS_TOTAL
+    # trainloop-3 was rotated/kept and trainloop-5 exists as sharded files.
+    assert any("trainloop-5" in f and ".shard" in f
+               for f in resumed["ckpt_files"]), resumed["ckpt_files"]
+    for k in straight["params"]:
+        np.testing.assert_allclose(resumed["params"][k], straight["params"][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
 def test_async_ps_example_runs(tmp_path):
     """The documented async-PS example (examples/async_ps_train.py) runs
     end-to-end: 2 processes, all updates applied, wire accounting reported."""
