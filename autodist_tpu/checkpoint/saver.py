@@ -25,10 +25,11 @@ Two formats, detected on restore:
   logical tensor to its index-slices across files — the SaveSliceInfo idea
   done TPU-first. Each distinct shard index is written exactly once, by the
   process holding the lowest-id device for it; the chief publishes the
-  manifest only after every writer's file landed (filesystem token barrier —
-  no device collectives in the save path, so a save can never interleave with
-  training collectives). Restore assembles full logical arrays from any
-  process count, so cross-topology restore works (merge-on-restore).
+  manifest only after every writer's file landed (coordination-service
+  barrier — host-side RPC, no device collectives in the save path, so a save
+  can never interleave with training collectives). Restore assembles full
+  logical arrays from any process count, so cross-topology restore works
+  (merge-on-restore).
 
 Optimizer state is saved under an ``__opt__/`` prefix, compressor state under
 ``__ef__/``, the step counter under ``__step__`` (v1) / the manifest (v2).
@@ -42,7 +43,6 @@ import json
 import os
 import re
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -154,11 +154,8 @@ def _encode_for_npz(data: np.ndarray):
 
 
 def _np_dtype(name: str):
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-        return np.dtype(getattr(ml_dtypes, name))
+    from autodist_tpu.parallel.wire import dtype_from_name
+    return dtype_from_name(name)
 
 
 def _decode_from_npz(data: np.ndarray, dtype: str) -> np.ndarray:
@@ -179,22 +176,6 @@ def _coord_client():
         return None
 
 
-def _wait_for(paths, timeout: float, what: str):
-    """Filesystem barrier: poll until every path exists (atomic renames make
-    existence imply completeness). Raises on timeout — a missing peer file
-    means a peer process died mid-save, and publishing a manifest over an
-    incomplete checkpoint would corrupt the rotation chain."""
-    deadline = time.monotonic() + timeout
-    pending = list(paths)
-    while pending:
-        pending = [p for p in pending if not os.path.exists(p)]
-        if not pending:
-            return
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"Checkpoint {what}: peer files missing after {timeout:.0f}s: "
-                f"{pending[:4]} — a peer process likely died mid-save")
-        time.sleep(0.05)
 
 
 def _nest(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -371,19 +352,16 @@ class Saver:
         seq = self._save_seq
         self._save_seq += 1
         if pcount > 1 and _coord_client() is None:
-            # Token-file fallback (no coordination service): sweep THIS
-            # process's stale tokens synchronously, before any write starts,
-            # so tokens left by a crashed earlier run at the same (step, seq)
-            # cannot satisfy a peer's barrier with stale data.
-            logging.warning(
-                "Sharded save without a jax.distributed coordination client: "
-                "falling back to filesystem-token barriers")
-            for stale in ([f"{prefix}.done-p{pidx:05d}-s{seq}"]
-                          + ([f"{prefix}.published-s{seq}"] if pidx == 0 else [])):
-                try:
-                    os.remove(stale)
-                except OSError:
-                    pass
+            # No safe ordering exists without communication: any
+            # filesystem-token scheme can be satisfied by artifacts a crashed
+            # earlier run left at the same step, publishing a manifest over
+            # stale shard data. Multi-process JAX always initializes the
+            # coordination service, so refusing loudly beats silently risking
+            # a corrupt checkpoint.
+            raise RuntimeError(
+                "Sharded multi-process save requires the jax.distributed "
+                "coordination service (jax.distributed.initialize), which "
+                "orders shard writes against the manifest publish")
         base = os.path.basename(prefix)
         files = {str(p): f"{base}.shard{p:05d}-of-{pcount:05d}.npz"
                  for p in sorted(writers)}
@@ -400,39 +378,22 @@ class Saver:
         pcount = manifest["process_count"]
         client = _coord_client() if pcount > 1 else None
         tag = f"adckpt:{os.path.basename(prefix)}:s{seq}"
-        token = lambda p: f"{prefix}.done-p{p:05d}-s{seq}"  # noqa: E731
         if pidx in writers:
             path = os.path.join(dirname, manifest["files"][str(pidx)])
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 np.savez(f, **own)
             os.replace(tmp, path)
-            if client is None and pidx != 0:
-                # No-coordination fallback only: a token (not the shard file
-                # itself) carries the barrier, so a shard file left by an
-                # earlier save of the SAME step can't satisfy the chief's
-                # wait early. (The primary barrier is the coordination
-                # service, which a crashed run cannot leave stale.)
-                with open(token(pidx), "w") as f:
-                    f.write(str(step))
         # Barrier 1: every writer's shard file has landed before the manifest
         # publishes, so a manifest on disk implies a complete checkpoint.
         if client is not None:
             client.wait_at_barrier(tag + ":written",
                                    timeout_in_ms=int(barrier_timeout * 1000))
-        elif pcount > 1 and pidx == 0:
-            _wait_for([token(p) for p in writers if p != 0], barrier_timeout,
-                      f"save {os.path.basename(prefix)}")
         if pidx == 0:
             tmp = prefix + ".json.tmp"
             with open(tmp, "w") as f:
                 json.dump(manifest, f, indent=1, sort_keys=True)
             os.replace(tmp, prefix + ".json")
-            for p in writers:  # consume fallback tokens (stale-token hygiene)
-                try:
-                    os.remove(token(p))
-                except OSError:
-                    pass
             self._load_rotation_state(save_path)
             self._rotate(prefix)
             self._update_state_file(save_path, prefix)
@@ -440,17 +401,11 @@ class Saver:
                 "Saved sharded checkpoint %s (step %d, %d tensors, %d writer "
                 "processes)", prefix, step, len(manifest["tensors"]),
                 len(writers))
-            if client is None and pcount > 1:
-                with open(f"{prefix}.published-s{seq}", "w") as f:
-                    f.write(str(step))
         # Barrier 2: peers return only once the manifest exists, so a save()
         # that returned implies a restorable checkpoint everywhere.
         if client is not None:
             client.wait_at_barrier(tag + ":published",
                                    timeout_in_ms=int(barrier_timeout * 1000))
-        elif pcount > 1 and pidx != 0:
-            _wait_for([f"{prefix}.published-s{seq}"], barrier_timeout,
-                      f"publish {os.path.basename(prefix)}")
 
     def _load_rotation_state(self, save_path: str):
         """Seed the rotation list from the files on disk so a restarted trainer
@@ -499,12 +454,9 @@ class Saver:
         while len(self._kept) > self._max_to_keep:
             victim = self._kept.pop(0)
             # ".npz"/".json" cover the single-file format; the glob sweeps a
-            # sharded checkpoint's per-process files and barrier/publish
-            # tokens (all named "<prefix>.<something>").
+            # sharded checkpoint's per-process files.
             doomed = {victim + ".npz", victim + ".json"}
             doomed.update(glob.glob(glob.escape(victim) + ".shard*-of-*.npz"))
-            doomed.update(glob.glob(glob.escape(victim) + ".published-s*"))
-            doomed.update(glob.glob(glob.escape(victim) + ".done-p*"))
             for path in doomed:
                 try:
                     os.remove(path)

@@ -1,7 +1,7 @@
 // Native framed-message data plane for the PS transport.
 //
 // The reference delegated its PS plane to TensorFlow's C++ grpc runtime
-// (SURVEY.md §2.4); here the Python protocol layer (pickle, versioning,
+// (SURVEY.md §2.4); here the Python protocol layer (typed wire codec,
 // staleness gate) stays Python and this library owns the bytes-on-the-wire
 // hot path: one writev for header+payload (the Python fallback concatenates,
 // copying the whole multi-MB payload), and one malloc + full-read loop for

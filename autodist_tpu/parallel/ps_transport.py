@@ -16,10 +16,12 @@ pull parameters and push gradients exactly like the reference's PS plane:
   the chief's staleness bound, pulls the current parameters, computes local
   gradients on its own devices, and pushes them back.
 
-Wire format: length-prefixed pickles of numpy pytrees (the launched cluster is
-one trust domain, as with the reference's unauthenticated grpc servers). The
-SPMD data plane is untouched — this is the host-side control/parameter plane
-that has no XLA equivalent.
+Wire format: length-prefixed TYPED messages (``parallel/wire.py`` — tag-based
+scalars/containers + dtype/shape-headed raw tensor bytes). Nothing on the
+socket is ever unpickled, so a hostile peer gets no code execution — the same
+property the reference's protobuf-over-grpc plane had (its servers were
+unauthenticated but typed). The SPMD data plane is untouched — this is the
+host-side control/parameter plane that has no XLA equivalent.
 
 The bytes-on-the-wire hot path is native (``native/transport.cc``, built
 lazily like the data loader): one writev per message and a single-buffer
@@ -30,7 +32,6 @@ Python path to keep timeout semantics.
 """
 
 import os
-import pickle
 import socket
 import socketserver
 import struct
@@ -40,6 +41,7 @@ from typing import Any, Optional, Tuple
 import jax
 import numpy as np
 
+from autodist_tpu.parallel import wire
 from autodist_tpu.utils import logging
 
 PyTree = Any
@@ -100,7 +102,13 @@ def _native_error(lib, what: str) -> ConnectionError:
 def _send_msg(sock: socket.socket, obj) -> int:
     """Send one framed message; returns the payload byte count (for the
     client's wire accounting)."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return _send_payload(sock, wire.encode(obj))
+
+
+def _send_payload(sock: socket.socket, payload: bytes) -> int:
+    """Send an already-encoded payload with framing (the server pre-encodes
+    replies so an encode failure can be reported instead of dropping the
+    connection)."""
     # Native path only for plain blocking sockets: a socket timeout must keep
     # Python's timeout semantics, which raw-fd syscalls would bypass.
     lib = _native_transport() if sock.gettimeout() is None else None
@@ -142,13 +150,14 @@ def _recv_msg(sock: socket.socket):
         if n < 0:
             raise _native_error(lib, "recv")
         try:
-            # Zero-copy view over the malloc'd buffer for unpickling.
+            # Zero-copy view over the malloc'd buffer; wire.decode copies
+            # tensor data out, so freeing right after is safe.
             view = memoryview((ctypes.c_char * n).from_address(out.value or 0))
-            return pickle.loads(view), n
+            return wire.decode(view), n
         finally:
             lib.tr_free(out)
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n)), n
+    return wire.decode(_recv_exact(sock, n)), n
 
 
 def _to_host(tree: PyTree) -> PyTree:
@@ -158,10 +167,11 @@ def _to_host(tree: PyTree) -> PyTree:
 class PSServer:
     """Serve a chief AsyncPSRunner's service + controller to remote workers.
 
-    ``host`` defaults to loopback: the transport deserializes with pickle, so
-    binding wider than the cluster's trust domain is the caller's explicit
-    choice (pass the coordinator address for real multi-node runs — the same
-    trust model as the reference's unauthenticated tf.Servers)."""
+    ``host`` defaults to loopback; pass the coordinator address for real
+    multi-node runs. The wire is typed (no unpickling — a hostile peer gets
+    data parsing, not code execution), but the protocol is unauthenticated
+    like the reference's tf.Servers, so binding wider than the cluster's
+    trust domain is still the caller's explicit choice."""
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  listen_sock: Optional[socket.socket] = None):
@@ -188,6 +198,20 @@ class PSServer:
                     while True:
                         msg, _ = _recv_msg(self.request)
                         reply = outer._dispatch(msg)
+                        try:
+                            payload = wire.encode(reply)
+                        except wire.WireError as e:
+                            # OUR reply is unencodable (e.g. the user's params
+                            # tree contains an unregistered pytree node) —
+                            # a server-side limitation, not a hostile peer:
+                            # tell the worker instead of dropping it.
+                            logging.warning(
+                                "PS transport: reply to %r is not "
+                                "wire-encodable (%s)", msg[0], e)
+                            payload = wire.encode((
+                                "error", "WireError",
+                                f"server reply to {msg[0]!r} is not "
+                                f"wire-encodable: {e}"))
                         # The generation token rides in the dispatch reply,
                         # read inside the controller's own critical section —
                         # a separate generation() read here could race a
@@ -213,7 +237,17 @@ class PSServer:
                             # allocations, whose id only the reply knows).
                             self.worker_id = reply[1]
                             self.worker_gen = reply[2]
-                        _send_msg(self.request, reply)
+                        _send_payload(self.request, payload)
+                except wire.WireError as e:
+                    # Malformed/out-of-vocabulary bytes (a broken or hostile
+                    # peer): drop the connection. Decoding allocates data only
+                    # — nothing on the socket can execute — so the worst such
+                    # a peer achieves is its own disconnect.
+                    logging.warning("PS transport: dropping connection with "
+                                    "malformed payload (%s)", e)
+                    if self.worker_id is not None:
+                        controller.retire(self.worker_id,
+                                          generation=self.worker_gen)
                 except (ConnectionError, OSError):
                     # A vanished worker must not freeze the staleness gate for
                     # everyone else (its step count would pin min(steps) forever).

@@ -41,6 +41,12 @@ class StalenessTimeout(TimeoutError):
     """A gated worker step did not become runnable within the timeout."""
 
 
+# Largest jump past the current gate size one register() may request; bounds
+# the per-call slot allocation against malformed/hostile ids (the gate list
+# grows one element at a time under its lock).
+_MAX_SLOT_GROWTH = 4096
+
+
 class StalenessController:
     """Bounded-staleness gate over per-worker completed-step counts.
 
@@ -113,6 +119,17 @@ class StalenessController:
         with self._cond:
             if worker_id is not None and worker_id < 0:
                 raise ValueError(f"worker_id must be >= 0, got {worker_id}")
+            if worker_id is not None \
+                    and worker_id > len(self._steps) + _MAX_SLOT_GROWTH:
+                # The gate grows one slot at a time: an absurd id (e.g. a
+                # malformed or hostile register over the transport) would
+                # allocate that many slots under the lock and wedge/OOM the
+                # chief. Legitimate elastic growth is incremental.
+                raise ValueError(
+                    f"worker_id {worker_id} is beyond the gate's current "
+                    f"{len(self._steps)} slot(s) + growth margin "
+                    f"{_MAX_SLOT_GROWTH}; register sequentially or pass None "
+                    f"to allocate the next id")
             if worker_id is not None and worker_id < len(self._steps) \
                     and worker_id not in self._retired:
                 # Already live: keep the count (a reseed would un-gate it) but

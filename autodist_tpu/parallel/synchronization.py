@@ -66,6 +66,14 @@ jax.tree_util.register_dataclass(EFState, data_fields=["error"], meta_fields=[])
 jax.tree_util.register_dataclass(
     PowerSGDState, data_fields=["error", "q"], meta_fields=[])
 
+# Compressor state crosses the PS transport (read/read_if_newer replies); the
+# typed wire codec reconstructs these nodes through its registry, never by
+# importing names off the socket (parallel/wire.py).
+from autodist_tpu.parallel.wire import register_wire_dataclass  # noqa: E402
+
+register_wire_dataclass(EFState)
+register_wire_dataclass(PowerSGDState)
+
 
 _WARNED: set = set()
 
