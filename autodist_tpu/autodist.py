@@ -187,6 +187,11 @@ class AutoDist:
         driving multiple in-process worker handles.
         """
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
+        # Builders that model memory (AutoStrategy) get the session's optimizer
+        # so regime decisions use exact state bytes, not an Adam-class guess.
+        observe = getattr(self._strategy_builder, "observe_optimizer", None)
+        if observe is not None:
+            observe(optimizer)
         strategy = self.build_strategy(model_spec)
         # Compile BEFORE multi-node setup: the plan's is_async is the single
         # source of truth for which communication plane _setup wires (pure proto

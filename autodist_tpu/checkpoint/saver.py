@@ -164,16 +164,17 @@ def _decode_from_npz(data: np.ndarray, dtype: str) -> np.ndarray:
 
 
 def _coord_client():
-    """The jax.distributed coordination-service client (None outside a
-    multi-process program). Its host-side barriers are the right save-path
-    synchronization: no device collectives (cannot interleave with training
-    programs), and the service dies with the run — a crashed save can never
-    leave a stale barrier for a restarted run, unlike filesystem tokens."""
-    try:
-        from jax._src import distributed
-        return distributed.global_state.client
-    except Exception:  # pragma: no cover - internal layout change
-        return None
+    """The jax.distributed coordination-service client (None when
+    jax.distributed was never initialized). Its host-side barriers are the
+    right save-path synchronization: no device collectives (cannot interleave
+    with training programs), and the service dies with the run — a crashed
+    save can never leave a stale barrier for a restarted run, unlike
+    filesystem tokens. Deliberately NO blanket except: only multi-process
+    programs reach this, where the module must exist — if a jax upgrade moves
+    the private API, the true ImportError/AttributeError surfaces here
+    instead of a misleading 'call jax.distributed.initialize' error."""
+    from jax._src import distributed
+    return distributed.global_state.client
 
 
 

@@ -198,6 +198,8 @@ class PSServer:
                     while True:
                         msg, _ = _recv_msg(self.request)
                         reply = outer._dispatch(msg)
+                        is_protocol = isinstance(msg, tuple) and bool(msg)
+                        op = msg[0] if is_protocol else "<malformed>"
                         try:
                             payload = wire.encode(reply)
                         except wire.WireError as e:
@@ -207,10 +209,10 @@ class PSServer:
                             # tell the worker instead of dropping it.
                             logging.warning(
                                 "PS transport: reply to %r is not "
-                                "wire-encodable (%s)", msg[0], e)
+                                "wire-encodable (%s)", op, e)
                             payload = wire.encode((
                                 "error", "WireError",
-                                f"server reply to {msg[0]!r} is not "
+                                f"server reply to {op!r} is not "
                                 f"wire-encodable: {e}"))
                         # The generation token rides in the dispatch reply,
                         # read inside the controller's own critical section —
@@ -218,7 +220,7 @@ class PSServer:
                         # concurrent re-registration and adopt the REPLACEMENT
                         # occupant's token (whose retire would then kill the
                         # live worker when this connection dies).
-                        if msg[0] in ("start_step", "finish_step") \
+                        if op in ("start_step", "finish_step") \
                                 and reply[0] == "ok":
                             # Capture ONCE, at the connection's first bind to
                             # this worker id. Refreshing on every message would
@@ -228,7 +230,7 @@ class PSServer:
                             if self.worker_id != msg[1]:
                                 self.worker_id = msg[1]
                                 self.worker_gen = reply[1]
-                        elif msg[0] == "register" and reply[0] == "ok":
+                        elif op == "register" and reply[0] == "ok":
                             # register DOES refresh: this connection's own
                             # registration bumped the slot's generation, so the
                             # old token is stale by construction.
@@ -280,6 +282,14 @@ class PSServer:
         return self._server.server_address
 
     def _dispatch(self, msg):
+        # The wire codec's vocabulary is wider than the protocol's: a peer
+        # can legally encode a bare dict/int/None, which would raise at
+        # msg[0] OUTSIDE the per-op try below and skip the gate retire.
+        if not isinstance(msg, tuple) or not msg \
+                or not isinstance(msg[0], str):
+            return ("error", "PSClientError",
+                    f"malformed protocol message: expected (op, ...) tuple, "
+                    f"got {type(msg).__name__}")
         op = msg[0]
         r = self._runner
         try:

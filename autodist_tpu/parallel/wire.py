@@ -100,6 +100,12 @@ def _enc(out: bytearray, obj: Any):
         # silently reshaping scalar gradients. tobytes() below serializes in
         # C order whatever the memory layout.
         arr = np.asarray(obj)
+        if arr.dtype.hasobject:
+            # tobytes() on an object array would serialize raw heap POINTERS
+            # — a memory-address leak the peer cannot decode anyway. Refuse
+            # at encode time so the server's reply-encode error path reports
+            # it as a server-side limitation.
+            raise WireError("object-dtype arrays are not wire-encodable")
         out += b"a"
         _enc_str(out, str(arr.dtype))
         out += bytes([arr.ndim])

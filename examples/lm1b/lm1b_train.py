@@ -14,11 +14,11 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax.numpy as jnp
-import optax
 
 from autodist_tpu import AutoDist
 from autodist_tpu.models import lstm_lm, transformer_lm
 from autodist_tpu.strategy import Parallax
+from autodist_tpu.strategy.auto_strategy import choose_optimizer
 from autodist_tpu.utils.metrics import ThroughputMeter
 
 
@@ -51,25 +51,14 @@ def main(argv=None):
     import jax
     on_accel = jax.default_backend() != "cpu"
     dtype = jnp.bfloat16 if on_accel else jnp.float32
-    # One predicate, two coupled decisions: the giant-vocab full-softmax run
-    # needs BOTH Adafactor (Adam's moments on ~4.9 GB of tables exceed HBM)
-    # and the smaller default batch (128 OOMs with the remaining headroom).
-    big_vocab = args.full_softmax and args.vocab > 100_000
-    if not args.batch_size:
-        args.batch_size = 96 if big_vocab else 128
 
     if args.model == "lstm":
         cfg = lstm_lm.LSTMLMConfig(
             vocab_size=args.vocab, emb_dim=args.d_model,
             hidden_dim=2 * args.d_model, n_layers=args.n_layers, dtype=dtype)
         model, params = lstm_lm.init_params(cfg)
-        if args.full_softmax:
-            loss_fn = lstm_lm.make_fused_full_softmax_loss_fn(model)
-            batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len,
-                                            sampled=False)
-        else:
-            loss_fn = lstm_lm.make_loss_fn(model)
-            batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
+        loss_fn = (lstm_lm.make_fused_full_softmax_loss_fn(model)
+                   if args.full_softmax else lstm_lm.make_loss_fn(model))
     else:
         cfg = transformer_lm.TransformerLMConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=8,
@@ -77,15 +66,26 @@ def main(argv=None):
             dtype=dtype, tied_output=False)
         model, params = transformer_lm.init_params(cfg)
         loss_fn = transformer_lm.make_loss_fn(model)
+
+    # Optimizer choice is the strategy layer's: choose_optimizer shape-
+    # evaluates Adam's exact state bytes against the device budget and falls
+    # back to Adafactor's factored second moment when the moments don't fit
+    # — the giant-vocab (793k) full-softmax config lands there (its two
+    # ~4.9 GB tables put Adam's f32 moments past one v5e's HBM). The smaller
+    # default batch rides the same decision: memory-tight configs get the
+    # headroom-safe 96 (128 OOMs there; v5e sweep otherwise favors 128).
+    choice = choose_optimizer(params, learning_rate=1e-3)
+    optimizer = choice.optimizer
+    print(f"optimizer: {choice.reason}")
+    if not args.batch_size:
+        args.batch_size = 96 if choice.factored else 128
+    if args.model == "lstm":
+        batch = lstm_lm.synthetic_batch(cfg, args.batch_size, args.seq_len,
+                                        sampled=not args.full_softmax)
+    else:
         batch = transformer_lm.synthetic_batch(cfg, args.batch_size, args.seq_len)
 
     ad = AutoDist(args.resource_spec, strategy_builder=Parallax())
-    # Full-vocab training keeps ~4.9 GB of parameters in the two 793k-row
-    # tables; Adam's unfactored moments on top of that (+ gradients and
-    # activations) exceed one v5e's 16 GB HBM, so the giant-vocab config uses
-    # Adafactor — the standard factored-second-moment choice for huge
-    # embeddings (state ~= params instead of 3x params).
-    optimizer = (optax.adafactor(1e-3) if big_vocab else optax.adam(1e-3))
     step = ad.function(loss_fn, params, optimizer, example_batch=batch)
     # Keep the synthetic batch device-resident: re-shipping it from host
     # every step benchmarks the host link, not the chip.

@@ -208,6 +208,76 @@ def test_end_to_end_matches_fixed_builder():
     assert l_auto == pytest.approx(l_ar, rel=1e-6)
 
 
+def test_optimizer_flips_regime_on_same_model():
+    """Exact state bytes from eval_shape: the SAME model under the SAME budget
+    lands in PS/ZeRO with Adam (params + 2x f32 moments), but AllReduce with
+    SGD (no state) and Adafactor (factored moments ~ a few % of params)."""
+    params = {f"w{i}": np.zeros((512, 512), np.float32) for i in range(3)}
+    budget = 7 << 20   # 3 MiB params; Adam needs ~9 MiB, sgd/adafactor ~3 MiB
+
+    def regime(optimizer):
+        b = AutoStrategy(memory_budget_bytes=budget, optimizer=optimizer)
+        strategy = b.build(ModelSpec(params), _spec())
+        return {_which(n) for n in strategy.proto.node_config}
+
+    assert regime(optax.adam(1e-3)) == {"ps_synchronizer"}
+    assert regime(optax.sgd(0.1)) == {"all_reduce_synchronizer"}
+    assert regime(optax.adafactor(1e-3)) == {"all_reduce_synchronizer"}
+
+
+def test_session_hands_optimizer_to_builder():
+    """create_distributed_session auto-wires observe_optimizer: no manual
+    plumbing, the builder sees the session's optimizer."""
+    params = {f"w{i}": np.zeros((512, 512), np.float32) for i in range(3)}
+    batch = {"x": np.zeros((8, 512), np.float32)}
+
+    def loss(p, b):
+        return sum(jnp.sum((b["x"] @ p[k]) ** 2) for k in p)
+
+    for optimizer, want in ((optax.adam(1e-3), "ps_synchronizer"),
+                            (optax.sgd(0.1), "all_reduce_synchronizer")):
+        builder = AutoStrategy(memory_budget_bytes=7 << 20)
+        ad = AutoDist(None, builder)
+        ad.create_distributed_session(loss, params, optimizer,
+                                      example_batch=batch)
+        kinds = {_which(n) for n in ad._strategy.proto.node_config}
+        assert kinds == {want}, (kinds, want)
+
+
+def test_adafactor_recommendation_when_moments_dominate():
+    """Memory-bound WITH Adam where params alone fit: the decision log
+    recommends factored moments instead of silently sharding."""
+    params = {f"w{i}": np.zeros((512, 512), np.float32) for i in range(3)}
+    b = AutoStrategy(memory_budget_bytes=7 << 20, optimizer=optax.adam(1e-3))
+    b.build(ModelSpec(params), _spec())
+    assert "adafactor" in b.explain()
+
+
+def test_choose_optimizer_picks_by_exact_fit():
+    from autodist_tpu.strategy.auto_strategy import choose_optimizer
+
+    params = {"emb": np.zeros((4096, 256), np.float32)}  # 4 MiB
+    tight = choose_optimizer(params, memory_budget_bytes=10 << 20)
+    roomy = choose_optimizer(params, memory_budget_bytes=64 << 20)
+    assert tight.factored and not roomy.factored
+    # The chosen optimizers are usable as-is.
+    for choice in (tight, roomy):
+        state = choice.optimizer.init({"w": jnp.zeros((4, 4))})
+        assert state is not None
+    assert "exceeds budget" in tight.reason and "<= budget" in roomy.reason
+
+
+def test_partition_log_prints_exact_bytes(caplog):
+    """Threshold comparisons print real byte counts (no '0 MiB >= 0 MiB' at
+    scaled-down thresholds)."""
+    params = {"big": np.zeros((4096, 64), np.float32)}  # 1 MiB
+    b = AutoStrategy(memory_budget_bytes=1 << 30,
+                     partition_threshold_bytes=256 << 10)
+    b.build(ModelSpec(params), _spec())
+    text = b.explain()
+    assert "1.00 MiB >= partition threshold 256 KiB" in text, text
+
+
 def test_explain_has_regime_and_per_param_rows():
     builder = AutoStrategy()
     builder.build(ModelSpec(_dense_params(n=2)), _spec())
