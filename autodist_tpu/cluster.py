@@ -71,8 +71,19 @@ def _own_addresses() -> frozenset:
 
 
 def is_local_address(address: str) -> bool:
-    """True for loopback addresses and this host's own names/IPs."""
-    return address in _LOOPBACK_ADDRESSES or address in _own_addresses()
+    """True for loopback addresses and this host's own names/IPs.
+
+    The whole 127.0.0.0/8 (and ::1) counts: Linux binds the full block to
+    ``lo``, and distinct loopback IPs are how a spec models several processes
+    on one host (node addresses must be unique, like the reference's
+    per-host cluster spec keys)."""
+    if address in _LOOPBACK_ADDRESSES or address in _own_addresses():
+        return True
+    try:
+        import ipaddress
+        return ipaddress.ip_address(address).is_loopback
+    except ValueError:
+        return False
 
 
 class Cluster:

@@ -1,5 +1,6 @@
 """Host data pipeline (native prefetch loader + device prefetch)."""
 
-from autodist_tpu.data.loader import DataLoader, device_prefetch
+from autodist_tpu.data.loader import (DataLoader, device_prefetch,
+                                      save_shards)
 
-__all__ = ["DataLoader", "device_prefetch"]
+__all__ = ["DataLoader", "device_prefetch", "save_shards"]

@@ -1,26 +1,36 @@
 """Host data pipeline: native threaded prefetch with a pure-Python fallback.
 
 The reference delegated its input pipeline to TF's C++ runtime (queues,
-iterators, staging — SURVEY.md §2.4 "host data plane"); this module owns the
-equivalent native capability in-tree. ``DataLoader`` serves shuffled, fixed-size
-batches from in-memory arrays:
+iterators, staging — SURVEY.md §2.4 "host data plane") and its examples read
+real corpora from disk (``examples/lm1b/lm1b_train.py:30-50``,
+``examples/benchmark/imagenet.py``); this module owns the equivalent native
+capability in-tree. ``DataLoader`` serves shuffled, fixed-size batches from
+in-memory arrays OR from ``.npy`` shard files on disk:
 
 - **Native path** (default): ``native/loader.cc`` is compiled once with g++ into
   the working dir and driven via ctypes. A C++ worker thread reshuffles indices
   per epoch and gathers rows into a prefetch ring off the GIL, so batch assembly
   overlaps the TPU step.
+- **File-backed datasets** (``files=``): each key names one or more ``.npy``
+  shards, opened with ``np.load(mmap_mode='r')`` — the gather thread reads rows
+  straight out of the page cache (cold pages fault in on the worker thread,
+  overlapped with the step), so datasets larger than RAM stream without ever
+  materializing. Shards are row-aligned across keys and virtually concatenated;
+  shuffling is global across all shards.
 - **Fallback path**: the same semantics in numpy (used when no C++ toolchain is
   available, and as the reference implementation in tests).
 
 ``device_prefetch`` composes either path with the runner's feed remapping: it
 keeps ``prefetch`` batches in flight on-device (``shard_batch`` = device_put
 with the batch sharding) so host->HBM transfer also overlaps the step.
+``save_shards`` writes a dict of arrays as row-aligned ``.npy`` shard files
+(the writer side of the ``files=`` contract).
 """
 
 import ctypes
 import os
 import threading
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -30,6 +40,8 @@ from autodist_tpu.utils import logging
 _BUILD_LOCK = threading.Lock()
 _LIB = None
 _LIB_FAILED = False
+
+FileSpec = Union[str, os.PathLike, Sequence[Union[str, os.PathLike]]]
 
 
 def _source_path() -> str:
@@ -48,10 +60,10 @@ def _build_native() -> Optional[ctypes.CDLL]:
         if lib is None:
             _LIB_FAILED = True
             return None
-        lib.dl_create.restype = ctypes.c_void_p
-        lib.dl_create.argtypes = [
-            ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+        lib.dl_create_sharded.restype = ctypes.c_void_p
+        lib.dl_create_sharded.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
         lib.dl_next.restype = ctypes.c_int
         lib.dl_next.argtypes = [ctypes.c_void_p,
@@ -64,26 +76,96 @@ def _build_native() -> Optional[ctypes.CDLL]:
         return _LIB
 
 
+def save_shards(arrays: Dict[str, np.ndarray], directory: str,
+                rows_per_shard: int) -> Dict[str, List[str]]:
+    """Write ``arrays`` as row-aligned ``.npy`` shard files under
+    ``directory`` (``<key>-00000.npy``, ...), returning the ``files=`` dict
+    that loads them back. The writer side of the file-backed contract."""
+    if rows_per_shard < 1:
+        raise ValueError("rows_per_shard must be >= 1")
+    lengths = {k: len(v) for k, v in arrays.items()}
+    if len(set(lengths.values())) != 1:
+        raise ValueError(f"All arrays must share a leading dim, got {lengths}")
+    n = next(iter(lengths.values()))
+    os.makedirs(directory, exist_ok=True)
+    out: Dict[str, List[str]] = {}
+    for key, arr in arrays.items():
+        # Sweep the key's previous shards first: re-preparing a SMALLER corpus
+        # must not leave stale high-numbered shards for glob-based consumers
+        # to silently mix into the dataset.
+        import glob as _glob
+        for stale in _glob.glob(os.path.join(_glob.escape(directory),
+                                             f"{_glob.escape(key)}-*.npy")):
+            os.remove(stale)
+        paths = []
+        for i, start in enumerate(range(0, n, rows_per_shard)):
+            path = os.path.join(directory, f"{key}-{i:05d}.npy")
+            np.save(path, np.ascontiguousarray(arr[start:start + rows_per_shard]))
+            paths.append(path)
+        out[key] = paths
+    return out
+
+
+def _open_segments(files: Dict[str, FileSpec]) -> Dict[str, List[np.ndarray]]:
+    """mmap every shard; validate row alignment across keys and dtype/shape
+    consistency across a key's shards."""
+    segs: Dict[str, List[np.ndarray]] = {}
+    for key, spec in files.items():
+        paths = [spec] if isinstance(spec, (str, os.PathLike)) else list(spec)
+        if not paths:
+            raise ValueError(f"files[{key!r}] names no shards")
+        arrs = [np.load(os.fspath(p), mmap_mode="r") for p in paths]
+        head = arrs[0]
+        for p, a in zip(paths, arrs):
+            if a.dtype != head.dtype or a.shape[1:] != head.shape[1:]:
+                raise ValueError(
+                    f"files[{key!r}]: shard {p} is {a.dtype}{a.shape[1:]} but "
+                    f"the first shard is {head.dtype}{head.shape[1:]}")
+        segs[key] = arrs
+    counts = {k: [len(a) for a in v] for k, v in segs.items()}
+    first = next(iter(counts.values()))
+    for k, c in counts.items():
+        if c != first:
+            raise ValueError(
+                f"Shards must be row-aligned across keys: per-shard rows "
+                f"{counts}")
+    return segs
+
+
 class DataLoader:
-    """Shuffled fixed-size batches over a dict of same-length arrays.
+    """Shuffled fixed-size batches over a dict of same-length arrays, or over
+    row-aligned ``.npy`` shard files (``files=``, memory-mapped).
 
     Continuous stream: iteration never ends (epochs reshuffle internally,
     drop-last semantics — static batch shapes only, the TPU constraint).
     ``native=None`` auto-selects; ``native=False`` forces the numpy fallback.
     """
 
-    def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
-                 shuffle: bool = True, seed: int = 0, prefetch: int = 2,
-                 native: Optional[bool] = None):
-        if not arrays:
-            raise ValueError("DataLoader needs at least one array")
-        lengths = {k: len(v) for k, v in arrays.items()}
-        if len(set(lengths.values())) != 1:
-            raise ValueError(f"All arrays must share a leading dim, got {lengths}")
-        self._keys = list(arrays)
-        # C-contiguous row-major so a row is one contiguous memcpy.
-        self._arrays = {k: np.ascontiguousarray(v) for k, v in arrays.items()}
-        self.n_rows = next(iter(lengths.values()))
+    def __init__(self, arrays: Optional[Dict[str, np.ndarray]] = None,
+                 batch_size: int = 1, shuffle: bool = True, seed: int = 0,
+                 prefetch: int = 2, native: Optional[bool] = None,
+                 files: Optional[Dict[str, FileSpec]] = None):
+        if (arrays is None) == (files is None):
+            raise ValueError("pass exactly one of arrays= or files=")
+        if files is not None:
+            self._segs = _open_segments(files)
+        else:
+            if not arrays:
+                raise ValueError("DataLoader needs at least one array")
+            lengths = {k: len(v) for k, v in arrays.items()}
+            if len(set(lengths.values())) != 1:
+                raise ValueError(
+                    f"All arrays must share a leading dim, got {lengths}")
+            self._segs = {k: [v] for k, v in arrays.items()}
+        self._keys = list(self._segs)
+        # C-contiguous row-major so a row is one contiguous memcpy. Memory-
+        # mapped .npy shards are C-order by construction (np.save), so this
+        # only ever copies misbehaved in-memory inputs — copying a mmap here
+        # would silently materialize the file.
+        self._segs = {k: [v if v.flags.c_contiguous else np.ascontiguousarray(v)
+                          for v in vs] for k, vs in self._segs.items()}
+        self._seg_rows = [len(v) for v in self._segs[self._keys[0]]]
+        self.n_rows = sum(self._seg_rows)
         if batch_size < 1 or batch_size > self.n_rows:
             raise ValueError(f"batch_size {batch_size} out of range "
                              f"[1, {self.n_rows}]")
@@ -105,17 +187,30 @@ class DataLoader:
             self._perm = None
             self._cursor = 0
             self._epochs = 0
+            self._seg_starts = np.cumsum([0] + self._seg_rows)
 
     # ------------------------------------------------------------------ native
     def _create_native(self):
-        n = len(self._keys)
-        ptrs = (ctypes.c_void_p * n)(
-            *[self._arrays[k].ctypes.data for k in self._keys])
+        n, n_seg = len(self._keys), len(self._seg_rows)
+        ptrs = (ctypes.c_void_p * (n * n_seg))(*[
+            self._segs[k][s].ctypes.data
+            for k in self._keys for s in range(n_seg)])
         row_bytes = (ctypes.c_uint64 * n)(
-            *[self._arrays[k].nbytes // self.n_rows for k in self._keys])
-        return self._lib.dl_create(
-            n, ptrs, row_bytes, self.n_rows, self.batch_size, self.prefetch,
-            int(self.shuffle), self.seed)
+            *[self._row_bytes(k) for k in self._keys])
+        seg_rows = (ctypes.c_uint64 * n_seg)(*self._seg_rows)
+        return self._lib.dl_create_sharded(
+            n, n_seg, ptrs, row_bytes, seg_rows, self.batch_size,
+            self.prefetch, int(self.shuffle), self.seed)
+
+    def _row_bytes(self, key: str) -> int:
+        head = self._segs[key][0]
+        return head.nbytes // len(head) if len(head) else 0
+
+    def _row_shape(self, key: str):
+        return self._segs[key][0].shape[1:]
+
+    def _dtype(self, key: str):
+        return self._segs[key][0].dtype
 
     @property
     def is_native(self) -> bool:
@@ -132,8 +227,8 @@ class DataLoader:
 
     def next(self) -> Dict[str, np.ndarray]:
         """The next batch (blocks on the prefetch ring in the native path)."""
-        out = {k: np.empty((self.batch_size,) + self._arrays[k].shape[1:],
-                           self._arrays[k].dtype) for k in self._keys}
+        out = {k: np.empty((self.batch_size,) + self._row_shape(k),
+                           self._dtype(k)) for k in self._keys}
         if self._handle is not None:
             ptrs = (ctypes.c_void_p * len(self._keys))(
                 *[out[k].ctypes.data for k in self._keys])
@@ -149,8 +244,13 @@ class DataLoader:
             self._cursor = 0
         idx = self._perm[self._cursor:self._cursor + self.batch_size]
         self._cursor += self.batch_size
+        seg = np.searchsorted(self._seg_starts, idx, side="right") - 1
+        local = idx - self._seg_starts[seg]
+        # Per-segment groupings are key-independent: compute once per batch.
+        groups = [(s, seg == s) for s in np.unique(seg)]
         for k in self._keys:
-            out[k][...] = self._arrays[k][idx]
+            for s, mask in groups:
+                out[k][mask] = self._segs[k][s][local[mask]]
         return out
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:

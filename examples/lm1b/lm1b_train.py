@@ -44,9 +44,33 @@ def main(argv=None):
                              "instead of the reference's sampled approximation — "
                              "works even at --vocab 793471 (lm1b's real size)")
     parser.add_argument("--resource_spec", type=str, default=None)
+    parser.add_argument("--data_dir", type=str, default=None,
+                        help="Stream training tokens from tokens-*.npy shards "
+                             "in this directory (memory-mapped; written by "
+                             "--write_synthetic_corpus or any tokenizer that "
+                             "saves [rows, seq_len+1] int32 .npy shards) "
+                             "instead of a device-resident synthetic batch")
+    parser.add_argument("--write_synthetic_corpus", type=int, default=0,
+                        metavar="ROWS",
+                        help="Write ROWS synthetic token rows as .npy shards "
+                             "into --data_dir and exit (corpus prep)")
     args = parser.parse_args(argv)
     if args.full_softmax and args.model != "lstm":
         parser.error("--full_softmax applies to --model lstm")
+    if args.write_synthetic_corpus:
+        if not args.data_dir:
+            parser.error("--write_synthetic_corpus needs --data_dir")
+        from autodist_tpu.data import save_shards
+        import numpy as np
+        rows = args.write_synthetic_corpus
+        rng = np.random.RandomState(0)
+        tokens = rng.randint(0, args.vocab, size=(rows, args.seq_len + 1),
+                             ).astype(np.int32)
+        files = save_shards({"tokens": tokens}, args.data_dir,
+                            rows_per_shard=max(1, rows // 8))
+        print(f"wrote {rows} rows across {len(files['tokens'])} shards "
+              f"in {args.data_dir}")
+        return None
 
     import jax
     on_accel = jax.default_backend() != "cpu"
@@ -87,9 +111,38 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, strategy_builder=Parallax())
     step = ad.function(loss_fn, params, optimizer, example_batch=batch)
-    # Keep the synthetic batch device-resident: re-shipping it from host
-    # every step benchmarks the host link, not the chip.
-    batch = step.runner.shard_batch(batch)
+
+    feed = None
+    if args.data_dir:
+        # Real input pipeline: tokens stream from memory-mapped .npy shards
+        # through the native prefetch ring (gather off the GIL) and
+        # device_prefetch (host->HBM ahead of the step) — the reference read
+        # its lm1b corpus from files the same way (lm1b_train.py:30-50).
+        if "neg_ids" in batch:
+            parser.error("--data_dir feeds token shards; the sampled-softmax "
+                         "LSTM draws negatives host-side per batch — use "
+                         "--full_softmax (or the transformer) with --data_dir")
+        import glob as globlib
+        from autodist_tpu.data import DataLoader, device_prefetch
+        shards = sorted(globlib.glob(os.path.join(args.data_dir, "tokens-*.npy")))
+        if not shards:
+            parser.error(f"no tokens-*.npy shards under {args.data_dir} "
+                         f"(--write_synthetic_corpus prepares one)")
+        import numpy as np
+        head = np.load(shards[0], mmap_mode="r")
+        if head.ndim != 2 or head.dtype != np.int32:
+            parser.error(f"corpus shards must be [rows, seq_len+1] int32; "
+                         f"{shards[0]} is {head.dtype} with {head.ndim} dims")
+        if head.shape[1] != args.seq_len + 1:
+            parser.error(f"corpus rows are {head.shape[1]} tokens wide; the "
+                         f"model needs seq_len+1 = {args.seq_len + 1}")
+        loader = DataLoader(files={"tokens": shards},
+                            batch_size=args.batch_size, shuffle=True)
+        feed = device_prefetch(loader, step.runner, depth=2)
+    else:
+        # Keep the synthetic batch device-resident: re-shipping it from host
+        # every step benchmarks the host link, not the chip.
+        batch = step.runner.shard_batch(batch)
 
     # wps counted over target tokens, logged per --log_every steps (reference
     # lm1b_train.py:64-74 cadence).
@@ -97,7 +150,7 @@ def main(argv=None):
                             log_every=args.log_every, unit="words")
     loss = None
     for i in range(args.steps):
-        loss = step(batch)
+        loss = step(next(feed) if feed is not None else batch)
         meter.step(sync=loss)
     print(f"final loss {float(loss):.4f}; average {meter.average or 0:.1f} words/sec")
     if not getattr(args, "full_softmax", False):

@@ -66,14 +66,22 @@ STEPS_TOTAL = 5   # checkpoint legs: save after 3, continue to 5
 VOCAB, DIM = 33, 4
 
 SINGLE = os.environ.get("AUTODIST_MATRIX_SINGLE") == "1"
+# Process count for the distributed mode (2 devices per process). The single-
+# process reference uses one node with the same GLOBAL device count, so the
+# mesh — and therefore collective/rounding behavior — is identical.
+PROCS = int(os.environ.get("AUTODIST_MATRIX_PROCS", "2"))
 
 
 def _spec(mesh=None):
     if SINGLE:
-        nodes = [{"address": "localhost", "tpus": 4, "chief": True}]
+        nodes = [{"address": "localhost", "tpus": 2 * PROCS, "chief": True}]
     else:
-        nodes = [{"address": "localhost", "tpus": 2, "chief": True},
-                 {"address": "127.0.0.1", "tpus": 2}]
+        # Node addresses must be unique (the reference's cluster-spec key
+        # contract); distinct 127/8 loopback IPs model multiple processes on
+        # one host and all take the local launch fast path.
+        nodes = [{"address": "localhost", "tpus": 2, "chief": True}] + \
+                [{"address": f"127.0.0.{i + 2}", "tpus": 2}
+                 for i in range(PROCS - 1)]
     info = {"nodes": nodes}
     if mesh:
         info["mesh"] = mesh
@@ -132,6 +140,16 @@ CONFIGS = {
         builder=lambda: AllReduce(compressor="PowerSGDCompressor",
                                   power_sgd_rank=2),
         mesh=None, optimizer=lambda: optax.sgd(LR)),
+    # The 3-tier mesh for the 4-process leg (AUTODIST_MATRIX_PROCS=4,
+    # 8 devices): model axis INSIDE each process's 2 devices (padded-uneven
+    # storage never crosses a process), reduce ACROSS process pairs (Adam
+    # moments ZeRO-sharded over the process boundary), data across the pair
+    # groups. Mesh axis order is (data, reduce, model) row-major over
+    # jax.devices(), which lists processes in order — so the coordinates
+    # land exactly there by construction.
+    "tp_zero": dict(builder=lambda: UnevenPartitionedPS(),
+                    mesh={"model": 2, "reduce": 2, "data": -1},
+                    optimizer=lambda: optax.adam(1e-2)),
 }
 
 
@@ -166,8 +184,10 @@ def main(out_path: str, config: str, phase: str = ""):
     runner = ad.create_distributed_session(
         loss_fn, params, cfg["optimizer"](), example_batch=make_batch(0))
     if not SINGLE:
-        assert jax.process_count() == 2, f"process_count={jax.process_count()}"
-    assert jax.device_count() == 4, f"device_count={jax.device_count()}"
+        assert jax.process_count() == PROCS, \
+            f"process_count={jax.process_count()} != {PROCS}"
+    assert jax.device_count() == 2 * PROCS, \
+        f"device_count={jax.device_count()} != {2 * PROCS}"
 
     ckpt_dir = os.environ.get("AUTODIST_MATRIX_CKPT_DIR")
 
@@ -245,9 +265,10 @@ def run_single_reference(out_path: str, config: str, workdir: str,
     env = dict(os.environ)
     for k in ROLE_ENV_VARS:
         env.pop(k, None)
+    procs = int(env.get("AUTODIST_MATRIX_PROCS", "2"))
     env.update({
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={2 * procs}",
         "AUTODIST_WORKING_DIR": workdir,
         "AUTODIST_MATRIX_SINGLE": "1",
         "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),

@@ -97,6 +97,19 @@ def test_lm1b_example_runs():
     assert avg is None or avg > 0
 
 
+def test_lm1b_example_trains_from_disk_shards(tmp_path):
+    """The real-input path: corpus prep writes .npy shards, then training
+    streams them memory-mapped through the native ring + device_prefetch."""
+    import examples.lm1b.lm1b_train as lm
+    common = ["--seq_len", "16", "--vocab", "128", "--data_dir", str(tmp_path)]
+    assert lm.main(["--write_synthetic_corpus", "64", *common]) is None
+    import glob
+    assert len(glob.glob(str(tmp_path / "tokens-*.npy"))) == 8
+    avg = lm.main(["--steps", "4", "--batch_size", "8", "--d_model", "32",
+                   "--n_layers", "1", "--log_every", "2", *common])
+    assert avg is None or avg > 0
+
+
 def test_imagenet_benchmark_tiny():
     import examples.benchmark.imagenet as im
     avg = im.main(["--model", "resnet50", "--strategy", "AllReduce",

@@ -150,8 +150,11 @@ def _run_matrix_config(tmp_path, config):
         f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
     single = json.loads(single_out.read_text())
     two = json.loads(two_out.read_text())
-    assert two["process_count"] == 2 and two["device_count"] == 4
-    assert single["process_count"] == 1 and single["device_count"] == 4
+    procs = int(os.environ.get("AUTODIST_MATRIX_PROCS", "2"))
+    assert two["process_count"] == procs \
+        and two["device_count"] == 2 * procs
+    assert single["process_count"] == 1 \
+        and single["device_count"] == 2 * procs
     # Same global mesh => the distributed run must be value-exact vs the
     # single-process reference (the reference's c0 criterion per strategy,
     # tests/integration/test_dist.py:14-42).
@@ -201,6 +204,22 @@ def test_cross_process_hierarchical_dcn_reduce(tmp_path):
     proves it EXECUTES across a process boundary)."""
     single, two = _run_matrix_config(tmp_path, "dcn")
     assert two["mesh"]["data"] == 2 and two["mesh"]["reduce"] == 2
+
+
+def test_four_process_tp_zero_mesh(tmp_path, monkeypatch):
+    """The 3-tier mesh over 4 REAL processes (8 devices): model axis inside
+    each process, reduce across process pairs (Adam moments ZeRO-sharded over
+    the boundary), data across pair groups — coordinate arithmetic a
+    2-process run cannot exercise. Value-exact vs a single-process 8-device
+    run on the identical mesh."""
+    monkeypatch.setenv("AUTODIST_MATRIX_PROCS", "4")
+    single, two = _run_matrix_config(tmp_path, "tp_zero")
+    assert two["process_count"] == 4 and two["device_count"] == 8
+    assert two["mesh"]["model"] == 2 and two["mesh"]["reduce"] == 2 \
+        and two["mesh"]["data"] == 2
+    # The 7-row parameter lives padded to 8 on the in-process model axis.
+    assert two["wu_storage_shape"] == [8, 4]
+    assert two["wu_shard_shapes"] == [[4, 4]]
 
 
 def test_cross_process_powersgd(tmp_path):
