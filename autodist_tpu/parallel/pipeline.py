@@ -1,16 +1,31 @@
-"""Pipeline parallelism over the mesh ``pipe`` axis (GPipe microbatch schedule).
+"""Pipeline parallelism over the mesh ``pipe`` axis (GPipe and 1F1B schedules).
 
 Beyond reference parity: the reference explicitly scoped pipeline parallelism out
 (``docs/design/architecture.rst:49-51``, SURVEY.md §2.2). The TPU-native design is
 the collective-permute formulation: stage parameters are sharded ``P("pipe", ...)``
 on their leading stage dimension, and inside a ``jax.shard_map`` manual region over
 the ``pipe`` axis each device runs its stage on a stream of microbatches, handing
-activations to the next stage with ``lax.ppermute``. The schedule is a single
-``lax.scan`` of ``num_microbatches + n_stages - 1`` ticks (fill + steady state +
-drain). Reverse-mode autodiff through the scan/ppermute yields the backward
-pipeline automatically — no hand-written backward schedule.
+activations to the next stage with ``lax.ppermute``.
 
-The loop is written for the *partial-manual* shard_map mode (``axis_names=
+Two schedules:
+
+- **GPipe** (:func:`pipelined`): a single forward ``lax.scan`` of
+  ``num_microbatches + n_stages - 1`` ticks; reverse-mode autodiff through the
+  scan yields the backward pipeline automatically. Simple, but autodiff stores
+  every tick's residuals, so live activation memory grows with
+  ``num_microbatches``.
+- **1F1B** (:func:`pipelined_value_and_grad`): each tick runs one forward AND
+  one backward slot per stage; a microbatch's backward starts as soon as its
+  activations return from downstream, so at most ``2*n_stages - 1`` microbatch
+  inputs are live per stage — activation memory is O(n_stages), independent of
+  the microbatch count. Backward recomputes the stage forward from its saved
+  INPUT (``jax.vjp`` inside the tick), the standard remat trade: one extra
+  forward per microbatch buys the O(n_stages) residency. The loss (tail) runs
+  inside the schedule at the last stage, which is what makes the interleaving
+  possible; total ticks = ``num_microbatches + 2*(n_stages - 1)`` versus
+  GPipe's ``2*(num_microbatches + n_stages - 1)``.
+
+Both are written for the *partial-manual* shard_map mode (``axis_names=
 {"pipe"}``): every other mesh axis stays under automatic SPMD partitioning, so
 pipeline composes with data parallelism (batch stays sharded on ``data``) and the
 other strategies.
@@ -73,6 +88,191 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x_mb: jax.Array,
     return jax.lax.psum(outputs * mask, axis)
 
 
+def onef_oneb_apply(stage_fn: Callable, tail_fn: Callable, stage_params: PyTree,
+                    tail_params: PyTree, x_mb: jax.Array, targets_mb: PyTree,
+                    axis: str = const.MESH_AXIS_PIPE):
+    """1F1B loop body — must run inside a shard_map manual over ``axis``.
+
+    ``stage_fn(stage_params, x) -> y`` is one stage on one microbatch;
+    ``tail_fn(tail_params, y, target) -> scalar`` is the post-pipeline head +
+    loss for one microbatch (run at the LAST stage, inside the schedule — the
+    placement that lets a microbatch's backward start while later microbatches
+    are still filling). Returns ``(mean_loss, stage_grads, tail_grads,
+    x_grads)``; ``x_grads`` is [M, ...] (d loss / d x_mb, for callers with
+    trainable pre-pipeline computation).
+
+    Schedule (S stages, M microbatches, tick t): stage r runs the forward of
+    microbatch ``t - r`` and the backward of microbatch ``t - (2S - 2 - r)``
+    (each when in [0, M)). Forward activations hop r -> r+1, backward input
+    grads hop r -> r-1, one ppermute each per tick. A microbatch's input is
+    held from its forward to its backward — at most ``2(S-1-r) + 1`` live per
+    stage, hence the O(n_stages) activation footprint.
+    """
+    n_stages = jax.lax.psum(1, axis)
+    rank = jax.lax.axis_index(axis)
+    n_mb = x_mb.shape[0]
+    last = n_stages - 1
+
+    def mb_at(tree, k):
+        return jax.tree_util.tree_map(
+            lambda l: jax.lax.dynamic_index_in_dim(l, k, 0, keepdims=False),
+            tree)
+
+    if n_stages == 1:
+        # Degenerate: plain per-microbatch value_and_grad accumulation.
+        def one(carry, k):
+            gs, gt, gx, acc = carry
+            def full(sp, tp, x):
+                return tail_fn(tp, stage_fn(sp, x), mb_at(targets_mb, k))
+            (l, (dgs, dgt, dgx)) = jax.value_and_grad(full, argnums=(0, 1, 2))(
+                stage_params, tail_params, x_mb[k])
+            gs = jax.tree_util.tree_map(jnp.add, gs, dgs)
+            gt = jax.tree_util.tree_map(jnp.add, gt, dgt)
+            gx = jax.lax.dynamic_update_index_in_dim(gx, dgx, k, 0)
+            return (gs, gt, gx, acc + l), None
+        zeros_s = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+        zeros_t = jax.tree_util.tree_map(jnp.zeros_like, tail_params)
+        (gs, gt, gx, acc), _ = jax.lax.scan(
+            one, (zeros_s, zeros_t, jnp.zeros_like(x_mb), jnp.zeros(())),
+            jnp.arange(n_mb))
+        scale = 1.0 / n_mb
+        return (acc * scale,
+                jax.tree_util.tree_map(lambda g: g * scale, gs),
+                jax.tree_util.tree_map(lambda g: g * scale, gt),
+                gx * scale)
+
+    ring_size = 2 * n_stages - 1  # max input lifetime + 1 (rank 0's window)
+    fwd_pairs = [(i, i + 1) for i in range(n_stages - 1)]
+    bwd_pairs = [(i + 1, i) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        a_recv, g_recv, ring, gs, gt, gx_buf, loss_acc = carry
+
+        # ---- F slot: forward of microbatch t - rank ----------------------
+        k_f = t - rank
+        f_valid = (k_f >= 0) & (k_f < n_mb)
+        x_in = jnp.where(rank == 0,
+                         jax.lax.dynamic_index_in_dim(
+                             x_mb, jnp.clip(k_f, 0, n_mb - 1), 0,
+                             keepdims=False),
+                         a_recv)
+        y = stage_fn(stage_params, x_in)
+        slot_f = jnp.mod(jnp.clip(k_f, 0, None), ring_size)
+        kept = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
+        ring = jax.lax.dynamic_update_index_in_dim(
+            ring, jnp.where(f_valid, x_in, kept), slot_f, 0)
+
+        # ---- B slot: backward of microbatch t - (2S - 2 - rank) ----------
+        k_b = t - (2 * n_stages - 2 - rank)
+        b_valid = (k_b >= 0) & (k_b < n_mb)
+        slot_b = jnp.mod(jnp.clip(k_b, 0, None), ring_size)
+        x_saved = jax.lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
+        # Recompute the stage forward from its saved INPUT (remat): vjp
+        # residuals cannot live in a scan carry, and this is what keeps the
+        # live set O(n_stages) instead of O(num_microbatches).
+        y_b, vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        tgt = mb_at(targets_mb, jnp.clip(k_b, 0, n_mb - 1))
+        loss_k, (d_tail, d_y) = jax.value_and_grad(
+            tail_fn, argnums=(0, 1))(tail_params, y_b, tgt)
+        g_y = jnp.where(rank == last, d_y, g_recv)
+        d_stage, d_x = vjp(g_y)
+        # b_valid suppresses fill/drain garbage; RANK ownership (loss and
+        # tail grads belong to the last stage, x grads to rank 0) is applied
+        # once, at the psum broadcast after the scan.
+        gs = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(b_valid, g, 0), gs, d_stage)
+        gt = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(b_valid, g, 0), gt, d_tail)
+        loss_acc = loss_acc + jnp.where(b_valid, loss_k, 0.0)
+        k_x = jnp.clip(k_b, 0, n_mb - 1)
+        prev = jax.lax.dynamic_index_in_dim(gx_buf, k_x, 0, keepdims=False)
+        gx_buf = jax.lax.dynamic_update_index_in_dim(
+            gx_buf, jnp.where(b_valid, d_x, prev), k_x, 0)
+
+        # ---- handoffs land next tick (F chain r->r+1, B chain r->r-1) ----
+        a_next = jax.lax.ppermute(y, axis, fwd_pairs)
+        g_next = jax.lax.ppermute(d_x, axis, bwd_pairs)
+        return (a_next, g_next, ring, gs, gt, gx_buf, loss_acc), None
+
+    zeros_s = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    zeros_t = jax.tree_util.tree_map(jnp.zeros_like, tail_params)
+    init = (
+        jnp.zeros_like(x_mb[0]),                                   # a_recv
+        jnp.zeros_like(x_mb[0]),                                   # g_recv
+        jnp.zeros((ring_size,) + x_mb.shape[1:], x_mb.dtype),      # ring
+        zeros_s, zeros_t,
+        jnp.zeros_like(x_mb),                                      # gx_buf
+        jnp.zeros(()),                                             # loss
+    )
+    n_ticks = n_mb + 2 * (n_stages - 1)
+    (_, _, _, gs, gt, gx_buf, loss_acc), _ = jax.lax.scan(
+        tick, init, jnp.arange(n_ticks))
+
+    scale = 1.0 / n_mb
+    # Loss/tail grads/x grads live only at their owning rank; psum with the
+    # ownership mask broadcasts them (stage grads stay per-rank shards).
+    last_mask = (rank == last).astype(loss_acc.dtype)
+    loss = jax.lax.psum(loss_acc * last_mask, axis) * scale
+    gt = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * (rank == last).astype(g.dtype), axis)
+        * scale, gt)
+    gx = jax.lax.psum(gx_buf * (rank == 0).astype(gx_buf.dtype), axis) * scale
+    gs = jax.tree_util.tree_map(lambda g: g * scale, gs)
+    return loss, gs, gt, gx
+
+
+def pipelined_value_and_grad(stage_fn: Callable, tail_fn: Callable,
+                             n_stages: int, axis: str = const.MESH_AXIS_PIPE,
+                             mesh=None) -> Callable:
+    """Wrap :func:`onef_oneb_apply` (the 1F1B schedule) in the partial-manual
+    shard_map.
+
+    Returns ``f(stage_params, tail_params, x_mb, targets_mb) ->
+    (mean_loss, stage_grads, tail_grads, x_grads)``. ``stage_params`` leaves
+    carry a leading stage dimension of size ``n_stages`` (sharded over
+    ``axis``); ``tail_params`` (head + loss parameters) are replicated;
+    ``x_mb``/``targets_mb`` are [num_microbatches, mb_batch, ...]. Must run
+    under ``jit``. Keep GPipe (:func:`pipelined` + autodiff) for the simple
+    mode; choose 1F1B when activation memory, not schedule simplicity, is the
+    constraint.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def f(stage_params, tail_params, x_mb, targets_mb):
+        m, specs = _pipe_mesh_and_specs("pipelined_value_and_grad", mesh,
+                                        axis, n_stages, stage_params)
+        tail_zero = jax.tree_util.tree_map(lambda _: P(), tail_params)
+        tgt_zero = jax.tree_util.tree_map(lambda _: P(), targets_mb)
+        return jax.shard_map(
+            lambda sp, tp, x, tg: onef_oneb_apply(stage_fn, tail_fn, sp, tp,
+                                                  x, tg, axis=axis),
+            mesh=m,
+            in_specs=(specs, tail_zero, P(), tgt_zero),
+            out_specs=(P(), specs, tail_zero, P()),
+            axis_names={axis}, check_vma=False,
+        )(stage_params, tail_params, x_mb, targets_mb)
+
+    return f
+
+
+def _pipe_mesh_and_specs(fn_name: str, mesh, axis: str, n_stages: int,
+                         stage_params):
+    """Shared mesh resolution + stage-size validation + P(axis) spec build for
+    both schedule wrappers. Without the size check a mismatched mesh silently
+    runs only the stage groups the pipe axis covers — finite loss, most
+    layers skipped."""
+    from jax.sharding import PartitionSpec as P
+
+    m = mesh if mesh is not None else _ambient_mesh()
+    mesh_stages = dict(m.shape).get(axis, 1)
+    if mesh_stages != n_stages:
+        raise ValueError(
+            f"{fn_name}(n_stages={n_stages}) needs mesh axis {axis!r} of that "
+            f"size, but the mesh has {axis}={mesh_stages}; size the mesh with "
+            f"the Pipeline strategy or a matching resource-spec mesh")
+    return m, jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+
+
 def _ambient_mesh():
     """The mesh in effect at trace time: the abstract-mesh context if set, else the
     ``with mesh:`` physical-mesh context the runner steps under."""
@@ -106,16 +306,8 @@ def pipelined(stage_fn: Callable, n_stages: int, axis: str = const.MESH_AXIS_PIP
     from jax.sharding import PartitionSpec as P
 
     def f(stage_params, x_mb):
-        m = mesh if mesh is not None else _ambient_mesh()
-        mesh_stages = dict(m.shape).get(axis, 1)
-        if mesh_stages != n_stages:
-            # Without this check a mismatched mesh silently runs only the stage
-            # groups the pipe axis covers — finite loss, most layers skipped.
-            raise ValueError(
-                f"pipelined(n_stages={n_stages}) needs mesh axis {axis!r} of that "
-                f"size, but the mesh has {axis}={mesh_stages}; size the mesh with "
-                f"the Pipeline strategy or a matching resource-spec mesh")
-        specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+        m, specs = _pipe_mesh_and_specs("pipelined", mesh, axis, n_stages,
+                                        stage_params)
         return jax.shard_map(
             lambda p, x: pipeline_apply(stage_fn, p, x, axis=axis),
             mesh=m, in_specs=(specs, P()), out_specs=P(),

@@ -1,4 +1,5 @@
-"""Pipeline parallelism: GPipe loop correctness, gradients, strategy, e2e training."""
+"""Pipeline parallelism: GPipe + 1F1B loop correctness, gradients, memory,
+strategy, e2e training."""
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +9,7 @@ import optax
 from autodist_tpu import AutoDist, ResourceSpec
 from autodist_tpu.model_spec import ModelSpec
 from autodist_tpu.models import pipeline_lm
-from autodist_tpu.parallel.pipeline import pipelined
+from autodist_tpu.parallel.pipeline import pipelined, pipelined_value_and_grad
 from autodist_tpu.parallel.plan import ShardingPlan
 from autodist_tpu.strategy import Pipeline, StrategyCompiler
 
@@ -55,6 +56,105 @@ def test_gpipe_loop_matches_sequential_forward_and_grad():
         ls, gs = jax.jit(jax.value_and_grad(loss_seq))(w, x_mb)
     np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gs), rtol=1e-4, atol=1e-5)
+
+
+def _onef_oneb_setup(s=4, m=6, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    w = (rng.randn(s, d, d) * 0.3).astype(np.float32)
+    head = (rng.randn(d, 3) * 0.3).astype(np.float32)
+    x_mb = rng.randn(m, 4, d).astype(np.float32)
+    t_mb = rng.randn(m, 4, 3).astype(np.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p[0])
+
+    def tail_fn(tp, y, tgt):
+        return jnp.mean((y @ tp - tgt) ** 2)
+
+    return w, head, x_mb, t_mb, stage_fn, tail_fn
+
+
+def test_onef_oneb_matches_gpipe_loss_and_grads():
+    """1F1B returns the SAME mean loss and gradients (stage, tail, input) as
+    GPipe + autodiff on the same stages — only the schedule differs."""
+    s, m = 4, 6
+    w, head, x_mb, t_mb, stage_fn, tail_fn = _onef_oneb_setup(s, m)
+    mesh = _pipe_mesh(s)
+
+    f_1f1b = pipelined_value_and_grad(stage_fn, tail_fn, s, mesh=mesh)
+    gpipe = pipelined(stage_fn, s, mesh=mesh)
+
+    def gpipe_loss(w, head, x, tgt):
+        y = gpipe(w, x)
+        losses = jax.vmap(lambda yk, tk: tail_fn(head, yk, tk))(y, tgt)
+        return losses.mean()
+
+    with mesh:
+        loss_b, gs_b, gt_b, gx_b = jax.jit(f_1f1b)(w, head, x_mb, t_mb)
+        loss_a, (gs_a, gt_a, gx_a) = jax.jit(jax.value_and_grad(
+            gpipe_loss, argnums=(0, 1, 2)))(w, head, x_mb, t_mb)
+    np.testing.assert_allclose(float(loss_b), float(loss_a), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs_b), np.asarray(gs_a),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gt_b), np.asarray(gt_a),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_b), np.asarray(gx_a),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_onef_oneb_single_stage_degenerate():
+    w, head, x_mb, t_mb, stage_fn, tail_fn = _onef_oneb_setup(s=1, m=4)
+    from autodist_tpu.parallel.mesh import build_mesh
+    mesh = build_mesh(axes={"pipe": 1, "data": -1})
+    f = pipelined_value_and_grad(stage_fn, tail_fn, 1, mesh=mesh)
+
+    def ref(w, head, x, tgt):
+        y = jax.vmap(lambda xk: stage_fn(w, xk))(x)
+        return jax.vmap(lambda yk, tk: tail_fn(head, yk, tk))(y, tgt).mean()
+
+    with mesh:
+        loss, gs, gt, gx = jax.jit(f)(w, head, x_mb, t_mb)
+        l_ref, (gs_r, gt_r, gx_r) = jax.jit(jax.value_and_grad(
+            ref, argnums=(0, 1, 2)))(w, head, x_mb, t_mb)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_r), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gt), np.asarray(gt_r), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), rtol=1e-4)
+
+
+def test_onef_oneb_memory_flat_in_microbatches():
+    """The point of 1F1B: compiled temp memory stays ~flat as num_microbatches
+    grows (live set O(n_stages)), while GPipe+autodiff's grows linearly
+    (residuals for every tick)."""
+    s, d = 4, 64
+    mesh = _pipe_mesh(s)
+
+    def measure(m):
+        w, head, x_mb, t_mb, stage_fn, tail_fn = _onef_oneb_setup(s, m, d)
+        f_1f1b = pipelined_value_and_grad(stage_fn, tail_fn, s, mesh=mesh)
+        gpipe = pipelined(stage_fn, s, mesh=mesh)
+
+        def gpipe_loss(w, head, x, tgt):
+            y = gpipe(w, x)
+            return jax.vmap(lambda yk, tk: tail_fn(head, yk, tk))(y, tgt).mean()
+
+        with mesh:
+            mem_b = jax.jit(f_1f1b).lower(w, head, x_mb, t_mb).compile() \
+                .memory_analysis().temp_size_in_bytes
+            mem_a = jax.jit(jax.value_and_grad(gpipe_loss, argnums=(0, 1))) \
+                .lower(w, head, x_mb, t_mb).compile() \
+                .memory_analysis().temp_size_in_bytes
+        return mem_a, mem_b
+
+    gpipe_4, onef_4 = measure(4)
+    gpipe_32, onef_32 = measure(32)
+    # GPipe's residual storage scales with the microbatch count (measured on
+    # this config: 49.7 KB -> 193.2 KB over 4 -> 32 microbatches)...
+    assert gpipe_32 > 3 * gpipe_4, (gpipe_4, gpipe_32)
+    # ...1F1B's live set does not (measured ~30.4 KB -> ~33.8 KB: the ring is
+    # sized by n_stages; slack covers the [M, ...] input-grad buffer).
+    assert onef_32 < 1.5 * onef_4, (onef_4, onef_32)
+    assert onef_32 < gpipe_32 / 4, (onef_32, gpipe_32)
 
 
 def test_pipeline_lm_matches_sequential_apply():
