@@ -1,6 +1,7 @@
-"""Host data pipeline (native prefetch loader + device prefetch)."""
+"""Host data pipeline (native prefetch loader + device prefetch + datasets)."""
 
+from autodist_tpu.data import movielens
 from autodist_tpu.data.loader import (DataLoader, device_prefetch,
                                       save_shards)
 
-__all__ = ["DataLoader", "device_prefetch", "save_shards"]
+__all__ = ["DataLoader", "device_prefetch", "save_shards", "movielens"]
