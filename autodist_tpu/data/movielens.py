@@ -207,8 +207,13 @@ def hit_rate_and_ndcg(score_fn: Callable, data: MovieLensData, k: int = 10,
         flat_u = np.repeat(cu, n_cand).astype(np.int32)
         flat_i = ci.reshape(-1).astype(np.int32)
         scores = np.asarray(score_fn(flat_u, flat_i)).reshape(len(cu), n_cand)
-        # Rank of the positive (column 0): count of strictly-better negatives.
-        rank = (scores[:, 1:] > scores[:, :1]).sum(axis=1)
+        # Mid-rank tie handling: strictly-better negatives count fully, ties
+        # count half. Strictly-greater alone would hand a CONSTANT scorer
+        # rank 0 (perfect HR/NDCG for a model that learned nothing); mid-rank
+        # puts it at chance level, matching sort-order tie-breaking in
+        # expectation.
+        rank = ((scores[:, 1:] > scores[:, :1]).sum(axis=1)
+                + (scores[:, 1:] == scores[:, :1]).sum(axis=1) / 2.0)
         hit = rank < k
         hits += hit.sum()
         ndcg += (hit / np.log2(rank + 2)).sum()

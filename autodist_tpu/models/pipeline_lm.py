@@ -73,6 +73,50 @@ def _block_apply(p, x, config: PipelineLMConfig):
     return x + h @ p["w2"].astype(x.dtype)
 
 
+# Forward-path pieces shared by PipelineLM.apply (GPipe + autodiff) and
+# make_onef_oneb_value_and_grad (1F1B): ONE definition each, so the two
+# schedules can never silently compute different math.
+
+def _embed_microbatches(cfg: PipelineLMConfig, params, tokens):
+    """Embedding + positions, reshaped [B, T, D] -> [M, B/M, T, D] (microbatch
+    index outermost-within-batch so data sharding stays on the per-microbatch
+    batch dim)."""
+    b, t = tokens.shape
+    m = cfg.num_microbatches
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = x + params["pos"][None, :t, :].astype(cfg.dtype)
+    return x.reshape(b // m, m, t, cfg.d_model).swapaxes(0, 1)
+
+
+def _stage_groups(cfg: PipelineLMConfig, block_params):
+    """[L, ...] block stacks -> [S, L/S, ...] stage groups (contiguous layers)."""
+    lps = cfg.n_layers // cfg.n_stages
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape(cfg.n_stages, lps, *a.shape[1:]), block_params)
+
+
+def _make_stage_fn(cfg: PipelineLMConfig):
+    def stage_fn(p, xb):
+        p = jax.tree_util.tree_map(lambda a: a[0], p)  # drop stage shard dim
+        def body(carry, layer_p):
+            return _block_apply(layer_p, carry, cfg), None
+        out, _ = jax.lax.scan(body, xb, p)
+        return out
+    return stage_fn
+
+
+def _head_logits(tail_params, y):
+    h = _layer_norm(y, tail_params["ln_f_s"], tail_params["ln_f_b"])
+    return h.astype(jnp.float32) @ tail_params["head"]
+
+
+def _nll(logits, targets):
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+
+
 class PipelineLM:
     """Functional model object: ``apply(params, tokens) -> logits``."""
 
@@ -82,48 +126,72 @@ class PipelineLM:
     def apply(self, params, tokens):
         cfg = self.config
         b, t = tokens.shape
-        m = cfg.num_microbatches
-        if b % m:
-            raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
-
-        x = params["embed"][tokens].astype(cfg.dtype)
-        x = x + params["pos"][None, :t, :].astype(cfg.dtype)
-
-        # [B, T, D] -> [M, B/M, T, D]: split the batch into microbatches with the
-        # microbatch index outermost-within-batch so the data sharding stays on the
-        # per-microbatch batch dim.
-        x_mb = x.reshape(b // m, m, t, cfg.d_model).swapaxes(0, 1)
-
-        # [L, ...] block stacks -> [S, L/S, ...] stage groups (contiguous layers).
-        lps = cfg.n_layers // cfg.n_stages
-        stage_params = jax.tree_util.tree_map(
-            lambda a: a.reshape(cfg.n_stages, lps, *a.shape[1:]), params["blocks"])
-
-        def stage_fn(p, xb):
-            p = jax.tree_util.tree_map(lambda a: a[0], p)  # drop stage shard dim
-            def body(carry, layer_p):
-                return _block_apply(layer_p, carry, cfg), None
-            out, _ = jax.lax.scan(body, xb, p)
-            return out
-
-        y_mb = pipelined(stage_fn, cfg.n_stages, axis=const.MESH_AXIS_PIPE)(
-            stage_params, x_mb)
-
+        x_mb = _embed_microbatches(cfg, params, tokens)
+        stage_params = _stage_groups(cfg, params["blocks"])
+        y_mb = pipelined(_make_stage_fn(cfg), cfg.n_stages,
+                         axis=const.MESH_AXIS_PIPE)(stage_params, x_mb)
         h = y_mb.swapaxes(0, 1).reshape(b, t, cfg.d_model)
-        h = _layer_norm(h, params["ln_f_s"], params["ln_f_b"])
-        return h.astype(jnp.float32) @ params["head"]
+        return _head_logits(params, h)
 
 
 def make_loss_fn(model: PipelineLM):
     def loss_fn(params, batch):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply(params, inputs)
-        logprobs = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        return _nll(model.apply(params, inputs), targets).mean()
 
     return loss_fn
+
+
+def make_onef_oneb_value_and_grad(model: PipelineLM):
+    """Full-model training step on the 1F1B schedule: ``f(params, batch) ->
+    (loss, grads)`` with gradients for EVERY parameter.
+
+    The model splits around the pipeline: embedding+positions run replicated
+    before it (their gradient returns through the schedule's input-grad
+    output), the stacked blocks run as pipeline stages, and the final
+    norm+head+loss is the in-schedule tail at the last stage. Gradients match
+    ``jax.grad(make_loss_fn(model))`` exactly; activation memory is
+    O(n_stages) instead of growing with ``num_microbatches`` (see
+    ``parallel/pipeline``). Feed the result to any optax optimizer."""
+    from autodist_tpu.parallel.pipeline import pipelined_value_and_grad
+
+    cfg = model.config
+
+    def f(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        b, t = inputs.shape
+        m = cfg.num_microbatches
+
+        def pre(pre_params, toks):
+            return _embed_microbatches(cfg, pre_params, toks)
+
+        pre_params = {"embed": params["embed"], "pos": params["pos"]}
+        x_mb, vjp_pre = jax.vjp(pre, pre_params, inputs)
+        targets_mb = targets.reshape(b // m, m, t).swapaxes(0, 1)
+        stage_params = _stage_groups(cfg, params["blocks"])
+        tail_params = {"ln_f_s": params["ln_f_s"], "ln_f_b": params["ln_f_b"],
+                       "head": params["head"]}
+
+        def tail_fn(tp, y, tgt):
+            return _nll(_head_logits(tp, y), tgt).mean()
+
+        loss, gs, gt, gx = pipelined_value_and_grad(
+            _make_stage_fn(cfg), tail_fn, cfg.n_stages,
+            axis=const.MESH_AXIS_PIPE)(
+                stage_params, tail_params, x_mb, targets_mb)
+        d_pre, _ = vjp_pre(gx.astype(x_mb.dtype))
+        grads = {
+            "embed": d_pre["embed"], "pos": d_pre["pos"],
+            "blocks": jax.tree_util.tree_map(
+                lambda g: g.reshape(cfg.n_layers, *g.shape[2:]), gs),
+            "ln_f_s": gt["ln_f_s"], "ln_f_b": gt["ln_f_b"],
+            "head": gt["head"],
+        }
+        return loss, grads
+
+    return f
 
 
 def init_params(config: PipelineLMConfig, rng: Optional[jax.Array] = None):

@@ -73,10 +73,12 @@ def main(argv=None):
         def epochs():
             seed = 0
             while True:
+                # sample_training_epoch already shuffles the epoch; a second
+                # loader-side permutation would only double host work.
                 loader = DataLoader(
                     arrays=movielens.sample_training_epoch(
                         data, args.num_neg, seed=seed),
-                    batch_size=batch_size, shuffle=True)
+                    batch_size=batch_size, shuffle=False)
                 for _ in range(max(1, loader.n_rows // batch_size)):
                     yield loader.next()
                 loader.close()

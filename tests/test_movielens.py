@@ -141,6 +141,12 @@ def test_hit_rate_and_ndcg_oracle():
     assert hr == 1.0
     np.testing.assert_allclose(ndcg, 1.0 / np.log2(3))
 
+    # A CONSTANT scorer (a model that learned nothing) must score at chance
+    # level, not 1.0 — mid-rank tie handling puts it at rank 15 of 30.
+    hr, ndcg = movielens.hit_rate_and_ndcg(
+        lambda u, i: np.zeros(len(u)), data, k=10, seed=3, num_negatives=30)
+    assert hr == 0.0 and ndcg == 0.0
+
 
 def test_ncf_example_trains_on_real_ratings(tmp_path):
     """End-to-end: the NCF benchmark trains on a ratings file and reports
