@@ -207,14 +207,19 @@ def hit_rate_and_ndcg(score_fn: Callable, data: MovieLensData, k: int = 10,
         flat_u = np.repeat(cu, n_cand).astype(np.int32)
         flat_i = ci.reshape(-1).astype(np.int32)
         scores = np.asarray(score_fn(flat_u, flat_i)).reshape(len(cu), n_cand)
-        # Mid-rank tie handling: strictly-better negatives count fully, ties
-        # count half. Strictly-greater alone would hand a CONSTANT scorer
-        # rank 0 (perfect HR/NDCG for a model that learned nothing); mid-rank
-        # puts it at chance level, matching sort-order tie-breaking in
-        # expectation.
-        rank = ((scores[:, 1:] > scores[:, :1]).sum(axis=1)
-                + (scores[:, 1:] == scores[:, :1]).sum(axis=1) / 2.0)
-        hit = rank < k
-        hits += hit.sum()
-        ndcg += (hit / np.log2(rank + 2)).sum()
+        # Tie handling = EXACT expectation under uniform tie placement: with
+        # s strictly-better negatives and t ties, the positive's rank is
+        # uniform over [s, s+t], so HR@k averages the indicator and NDCG@k
+        # averages 1/log2(rank+2) over that window. Strictly-greater alone
+        # would hand a CONSTANT scorer rank 0 (perfect metrics for a model
+        # that learned nothing); a mid-rank point estimate still gives
+        # all-or-nothing credit through the rank<k threshold.
+        s = (scores[:, 1:] > scores[:, :1]).sum(axis=1)           # [U]
+        t = (scores[:, 1:] == scores[:, :1]).sum(axis=1)          # [U]
+        pos = np.arange(n_cand)[None, :]                          # [1, C]
+        in_window = (pos >= s[:, None]) & (pos <= (s + t)[:, None])
+        gain = np.where(pos < k, 1.0 / np.log2(pos + 2), 0.0)
+        width = (t + 1).astype(np.float64)
+        hits += ((in_window & (pos < k)).sum(axis=1) / width).sum()
+        ndcg += ((in_window * gain).sum(axis=1) / width).sum()
     return hits / n_users, ndcg / n_users

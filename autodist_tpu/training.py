@@ -32,6 +32,7 @@ def train(runner, params: PyTree,
           batch_size: Optional[int] = None,
           is_chief: Optional[bool] = None,
           resume: bool = True,
+          async_save: bool = False,
           on_metrics: Optional[Callable[[int, float, float], None]] = None,
           eval_every: int = 0,
           eval_batch: Any = None,
@@ -45,7 +46,11 @@ def train(runner, params: PyTree,
     :meth:`Saver.save` at the same step, writes the state shards it owns, and
     only the chief publishes the manifest + rotation — the c10
     shared-filesystem protocol against cross-process-sharded state. With one
-    process (or async-PS worker roles), saves stay chief-only. ``on_metrics(step, loss, rate)`` fires every
+    process (or async-PS worker roles), saves stay chief-only.
+    ``async_save=True`` makes PERIODIC saves double-buffered (device snapshot
+    synchronous, file IO behind the step loop — :meth:`Saver.save`); the
+    final save is always synchronous, so the returned state is durably on
+    disk. ``on_metrics(step, loss, rate)`` fires every
     ``log_every`` steps. With ``eval_every`` and ``eval_batch``, the runner's
     forward-only :meth:`evaluate` runs every ``eval_every`` steps on the
     current params (``eval_fn`` defaults to the loss) and ``on_eval(step,
@@ -136,8 +141,13 @@ def train(runner, params: PyTree,
                 on_eval(step_i + 1, val)
         if (saver is not None and save_participant and save_every
                 and (step_i + 1) % save_every == 0 and step_i + 1 < steps):
-            saver.save(state, prefix_base, runner=runner)
+            saver.save(state, prefix_base, runner=runner,
+                       async_write=async_save)
 
     if saver is not None and save_participant and int(state.step) > start:
+        # Final save stays synchronous: train() returning means the state is
+        # durably on disk (save() joins any in-flight periodic write first).
         saver.save(state, prefix_base, runner=runner)
+    if saver is not None:
+        saver.wait()
     return state

@@ -48,7 +48,7 @@ if [[ "$FAST" == "1" ]]; then
     exit 0
 fi
 
-echo "=== [3/4] multi-chip dryrun (virtual 8-device mesh + real 2-process leg) ==="
+echo "=== [3/4] multi-chip dryrun (virtual 8-device mesh + real 2- and 4-process legs) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

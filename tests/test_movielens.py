@@ -141,11 +141,20 @@ def test_hit_rate_and_ndcg_oracle():
     assert hr == 1.0
     np.testing.assert_allclose(ndcg, 1.0 / np.log2(3))
 
-    # A CONSTANT scorer (a model that learned nothing) must score at chance
-    # level, not 1.0 — mid-rank tie handling puts it at rank 15 of 30.
-    hr, ndcg = movielens.hit_rate_and_ndcg(
-        lambda u, i: np.zeros(len(u)), data, k=10, seed=3, num_negatives=30)
-    assert hr == 0.0 and ndcg == 0.0
+    # A CONSTANT scorer (a model that learned nothing) must score at CHANCE
+    # level: rank uniform over the full candidate list, so HR@10 = 10/31 and
+    # NDCG@10 = mean over positions 0..30 of (p<10)/log2(p+2) — including
+    # when the clamp leaves fewer than 2k negatives (the all-or-nothing
+    # failure mode of point-estimate tie ranks).
+    flat = lambda u, i: np.zeros(len(u))  # noqa: E731
+    hr, ndcg = movielens.hit_rate_and_ndcg(flat, data, k=10, seed=3,
+                                           num_negatives=30)
+    np.testing.assert_allclose(hr, 10 / 31)
+    np.testing.assert_allclose(
+        ndcg, np.mean([1 / np.log2(p + 2) for p in range(10)] + [0] * 21))
+    hr, ndcg = movielens.hit_rate_and_ndcg(flat, data, k=10, seed=3,
+                                           num_negatives=18)
+    np.testing.assert_allclose(hr, 10 / 19)  # NOT 1.0
 
 
 def test_ncf_example_trains_on_real_ratings(tmp_path):

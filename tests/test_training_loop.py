@@ -76,6 +76,29 @@ def test_periodic_saves_and_rotation(tmp_path):
     assert Saver.latest_checkpoint(ckpt).endswith("model-9")
 
 
+def test_async_periodic_saves_match_sync(tmp_path):
+    """async_save=True: periodic writes ride the background thread but the
+    on-disk result — rotation, latest pointer, resumability — is identical
+    to synchronous saving, and train() returns with everything durable."""
+    sync_dir, async_dir = str(tmp_path / "sync"), str(tmp_path / "async")
+    train(_runner(), _params(), _batch_fn, steps=9, checkpoint_dir=sync_dir,
+          save_every=2, max_to_keep=3, log_every=0)
+    train(_runner(), _params(), _batch_fn, steps=9, checkpoint_dir=async_dir,
+          save_every=2, max_to_keep=3, log_every=0, async_save=True)
+    import glob
+    import os
+    names = lambda d: sorted(os.path.basename(p)  # noqa: E731
+                             for p in glob.glob(f"{d}/model-*.npz"))
+    assert names(sync_dir) == names(async_dir)
+    assert Saver.latest_checkpoint(async_dir).endswith("model-9")
+    resumed = train(_runner(), _params(), _batch_fn, steps=12,
+                    checkpoint_dir=async_dir, log_every=0, async_save=True)
+    direct = train(_runner(), _params(), _batch_fn, steps=12, log_every=0)
+    d, r = jax.device_get(direct.params), jax.device_get(resumed.params)
+    for k in d:
+        np.testing.assert_allclose(r[k], d[k], rtol=1e-6, atol=1e-6)
+
+
 def test_iterator_batches_end_early():
     batches = [_batch_fn(i) for i in range(4)]
     state = train(_runner(), _params(), iter(batches), steps=100, log_every=0)
