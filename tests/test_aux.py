@@ -33,9 +33,13 @@ def test_throughput_meter_excludes_warmup():
     for _ in range(5):
         meter.step()
         time.sleep(0.001)
+    fast_elapsed = time.perf_counter() - t0
     avg = meter.average
-    # Average must reflect the fast steps only (~1000/s), not the 0.2s warmup.
-    assert avg > 100
+    # The property is EXCLUSION of the warmup, not an absolute rate (which a
+    # loaded CI host can depress arbitrarily): the reported average must beat
+    # the rate the same steps would show with the 0.2 s warmup counted.
+    with_warmup = 7 / (0.2 + fast_elapsed)
+    assert avg > 2 * with_warmup, (avg, with_warmup)
 
 
 def test_dump_stage_writes_jaxpr_and_hlo(tmp_path):
