@@ -177,6 +177,10 @@ def test_wire_codec_fuzz_roundtrip():
         if kind == 1:
             return bool(rng.randint(2))
         if kind == 2:
+            # Mix i64-range ints with arbitrary-precision ones so the 'I'
+            # decimal-string escape path gets fuzzed in nested shapes too.
+            if rng.randint(4) == 0:
+                return int(rng.randint(-2**40, 2**40)) << 70
             return int(rng.randint(-2**40, 2**40))
         if kind == 3:
             return float(rng.randn())
