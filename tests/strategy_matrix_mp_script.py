@@ -57,7 +57,7 @@ import optax  # noqa: E402
 from autodist_tpu import AutoDist  # noqa: E402
 from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
 from autodist_tpu.strategy import (AllReduce, PS, Parallax,  # noqa: E402
-                                   UnevenPartitionedPS)
+                                   PartitionedAR, UnevenPartitionedPS)
 
 BATCH = 16
 LR = 0.05
@@ -150,6 +150,15 @@ CONFIGS = {
     "tp_zero": dict(builder=lambda: UnevenPartitionedPS(),
                     mesh={"model": 2, "reduce": 2, "data": -1},
                     optimizer=lambda: optax.adam(1e-2)),
+    # PartitionedAR: model-axis storage sharding (incl. padded-uneven wu,
+    # 7 -> 8) with all-reduce gradient sync. Canonical axis order puts data
+    # outermost, so on 2 processes the model shards live IN-process and the
+    # per-shard gradient all-reduce is what crosses the boundary — the
+    # partitioned-storage + cross-process-AR lowering the other configs
+    # don't cover. (tp_zero is the config whose storage spans processes.)
+    "par": dict(builder=lambda: PartitionedAR(),
+                mesh={"model": 2, "data": -1},
+                optimizer=lambda: optax.adam(1e-2)),
 }
 
 

@@ -222,6 +222,20 @@ def test_four_process_tp_zero_mesh(tmp_path, monkeypatch):
     assert two["wu_shard_shapes"] == [[4, 4]]
 
 
+def test_cross_process_partitioned_allreduce(tmp_path):
+    """PartitionedAR across 2 real processes: model-sharded (padded-uneven)
+    parameter storage with the per-shard gradient all-reduce crossing the
+    process boundary (the data axis spans the processes; the model shards
+    live in-process under the canonical axis order), value-exact."""
+    single, two = _run_matrix_config(tmp_path, "par")
+    assert two["mesh"]["model"] == 2 and two["mesh"]["data"] == 2
+    # Physical evidence: the 7-row param is padded to 8 and stored as (4, 4)
+    # tiles; w2's Adam moments follow the (2, 4) model sharding.
+    assert two["wu_storage_shape"] == [8, 4]
+    assert two["wu_shard_shapes"] == [[4, 4]]
+    assert two["w2_opt_shard_shapes"] == [[2, 4]]
+
+
 def test_cross_process_powersgd(tmp_path):
     """PowerSGD's factor pmeans (P/Q low-rank wire) across 2 real processes,
     exact vs the single-process run (deterministic QR + same shard count)."""
@@ -354,6 +368,40 @@ def test_cross_process_train_loop_checkpoint_resume(tmp_path, monkeypatch):
                for f in resumed["ckpt_files"]), resumed["ckpt_files"]
     for k in straight["params"]:
         np.testing.assert_allclose(resumed["params"][k], straight["params"][k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_cross_process_ring_attention_sequence_parallel(tmp_path):
+    """Long-context across REAL processes: a 4-way seq axis spanning the
+    2-process boundary, so ring attention's K/V ppermute hops cross between
+    OS processes — value-exact vs the single-process run on the same mesh."""
+    import os
+
+    import tests.seq_parallel_mp_script as sp
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "seq_parallel_mp_script.py")
+    single_out = tmp_path / "sp_single.json"
+    proc = sp.run_single_reference(str(single_out), str(tmp_path / "wd_single"))
+    assert proc.returncode == 0, (
+        f"single-process SP reference failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+    two_out = tmp_path / "sp_two.json"
+    proc = mp_script.run_two_process_chief(
+        str(two_out), str(tmp_path / "wd_two"), script=script)
+    assert proc.returncode == 0, (
+        f"2-process SP chief failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+    single = json.loads(single_out.read_text())
+    two = json.loads(two_out.read_text())
+    assert two["process_count"] == 2 and two["mesh"]["seq"] == 4
+    np.testing.assert_allclose(two["losses"], single["losses"],
+                               rtol=1e-5, atol=1e-6)
+    for k in single["params_sample"]:
+        np.testing.assert_allclose(two["params_sample"][k],
+                                   single["params_sample"][k],
                                    rtol=1e-5, atol=1e-6, err_msg=k)
 
 
