@@ -59,6 +59,12 @@ class Coordinator:
             }
             if const.ENV.AUTODIST_IS_TESTING.val:
                 env[const.ENV.AUTODIST_IS_TESTING.name] = "1"
+            # The reference propagated its path env vars to every worker
+            # (coordinator.py:70-79); a user script driven by SYS_RESOURCE_PATH /
+            # SYS_DATA_PATH must resolve them identically when re-executed.
+            for var in (const.ENV.SYS_RESOURCE_PATH, const.ENV.SYS_DATA_PATH):
+                if var.val:
+                    env[var.name] = var.val
             if extra_env:
                 env.update({k: str(v) for k, v in extra_env.items()})
             cmd = [sys.executable] + self._argv

@@ -28,8 +28,14 @@ import optax  # noqa: E402
 from autodist_tpu import AutoDist  # noqa: E402
 from autodist_tpu.strategy import AllReduce  # noqa: E402
 
-SPEC = ("nodes: [{address: localhost, tpus: 2, chief: true}, "
-        "{address: 127.0.0.1, tpus: 2}]")
+# Default spec: two processes on one machine (the pytest / dryrun shape).
+# SYS_RESOURCE_PATH (the reference's resource-spec env var, propagated to
+# workers by the Coordinator) points at a spec FILE instead, so the same
+# script drives the two-container distributed CI stage
+# (docker/compose.dist.yml), where the worker is a separate host over ssh.
+SPEC = os.environ.get("SYS_RESOURCE_PATH") or (
+    "nodes: [{address: localhost, tpus: 2, chief: true}, "
+    "{address: 127.0.0.1, tpus: 2}]")
 BATCH = 16
 LR = 0.1
 STEPS = 3
@@ -82,7 +88,11 @@ def main(out_path: str):
 # would make it think it is a worker; a stale coordinator env would misroute init).
 # The coordinator port is not here: run_two_process_chief always sets it fresh.
 ROLE_ENV_VARS = ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID", "AUTODIST_PROCESS_ID",
-                 "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR")
+                 "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR",
+                 # A spec path exported while driving the docker dist stage must
+                 # not leak into subprocess tests (it would swap their localhost
+                 # spec for the container spec and try to ssh to 'worker').
+                 "SYS_RESOURCE_PATH", "SYS_DATA_PATH")
 
 
 def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300,

@@ -1,0 +1,35 @@
+"""Shared env recipe for launching single-process reference runs.
+
+One definition of the scrub-role-env + CPU-sim-mesh + repo-on-PYTHONPATH
+launch environment, used by ``strategy_matrix_mp_script.run_single_reference``
+and ``seq_parallel_mp_script.run_single_reference`` — the two must stay
+identical or the single-process references silently diverge from the
+multi-process runs they are compared against.
+"""
+
+import os
+
+
+def single_reference_env(workdir: str, device_count: int) -> dict:
+    """Environment for a single-process reference subprocess: role env scrubbed
+    (including a stale SYS_RESOURCE_PATH from a developer shell), CPU platform
+    with ``device_count`` virtual devices, repo root prepended to PYTHONPATH,
+    and ``AUTODIST_MATRIX_SINGLE=1`` so the script takes its single-process
+    branch."""
+    from examples.multiprocess_linear_regression import ROLE_ENV_VARS
+
+    env = dict(os.environ)
+    for k in ROLE_ENV_VARS:
+        env.pop(k, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={device_count}",
+        "AUTODIST_WORKING_DIR": workdir,
+        "AUTODIST_MATRIX_SINGLE": "1",
+        "PYTHONPATH": repo_root() + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    return env
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -98,24 +98,14 @@ def main(out_path: str):
 
 def run_single_reference(out_path: str, workdir: str, timeout: int = 300):
     """Run this script once, single-process, on a 4-device sim mesh (the
-    same env recipe as the strategy matrix's helper)."""
+    strategy matrix's shared env recipe, ``tests/mp_env.py``)."""
     import subprocess
 
-    from examples.multiprocess_linear_regression import ROLE_ENV_VARS
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    for k in ROLE_ENV_VARS:
-        env.pop(k, None)
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "AUTODIST_WORKING_DIR": workdir,
-        "AUTODIST_MATRIX_SINGLE": "1",
-        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
-    })
+    from tests.mp_env import repo_root, single_reference_env
+    env = single_reference_env(workdir, device_count=4)
     return subprocess.run(
         [sys.executable, os.path.abspath(__file__), out_path],
-        env=env, cwd=repo_root, capture_output=True, text=True,
+        env=env, cwd=repo_root(), capture_output=True, text=True,
         timeout=timeout)
 
 

@@ -266,26 +266,17 @@ def _write_result(out_path, config, runner, state, losses, extra):
 
 def run_single_reference(out_path: str, config: str, workdir: str,
                          timeout: int = 300, phase: str = ""):
-    """Run this script once, single-process, on a 4-device sim mesh."""
+    """Run this script once, single-process, on a sim mesh matching the
+    multi-process run's global device count (2 devices per process)."""
     import subprocess
 
-    from examples.multiprocess_linear_regression import ROLE_ENV_VARS
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    for k in ROLE_ENV_VARS:
-        env.pop(k, None)
-    procs = int(env.get("AUTODIST_MATRIX_PROCS", "2"))
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": f"--xla_force_host_platform_device_count={2 * procs}",
-        "AUTODIST_WORKING_DIR": workdir,
-        "AUTODIST_MATRIX_SINGLE": "1",
-        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
-    })
+    from tests.mp_env import repo_root, single_reference_env
+    procs = int(os.environ.get("AUTODIST_MATRIX_PROCS", "2"))
+    env = single_reference_env(workdir, device_count=2 * procs)
     args = [sys.executable, os.path.abspath(__file__), out_path, config]
     if phase:
         args.append(phase)
-    return subprocess.run(args, env=env, cwd=repo_root, capture_output=True,
+    return subprocess.run(args, env=env, cwd=repo_root(), capture_output=True,
                           text=True, timeout=timeout)
 
 

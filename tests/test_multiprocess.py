@@ -102,8 +102,8 @@ def test_cross_process_bounded_staleness_ps(tmp_path):
         "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         + os.pathsep + env.get("PYTHONPATH", ""),
     })
-    for k in ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID", "AUTODIST_PROCESS_ID",
-              "AUTODIST_NUM_PROCESSES", "AUTODIST_COORDINATOR_ADDR"):
+    from examples.multiprocess_linear_regression import ROLE_ENV_VARS
+    for k in ROLE_ENV_VARS:
         env.pop(k, None)
 
     proc = subprocess.run([sys.executable, script, str(out)], env=env,
