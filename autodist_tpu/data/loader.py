@@ -158,12 +158,24 @@ class DataLoader:
                     f"All arrays must share a leading dim, got {lengths}")
             self._segs = {k: [v] for k, v in arrays.items()}
         self._keys = list(self._segs)
-        # C-contiguous row-major so a row is one contiguous memcpy. Memory-
-        # mapped .npy shards are C-order by construction (np.save), so this
-        # only ever copies misbehaved in-memory inputs — copying a mmap here
-        # would silently materialize the file.
-        self._segs = {k: [v if v.flags.c_contiguous else np.ascontiguousarray(v)
-                          for v in vs] for k, vs in self._segs.items()}
+        # C-contiguous row-major so a row is one contiguous memcpy. save_shards
+        # writes C-order, so this only ever copies misbehaved in-memory inputs
+        # (arrays= keeps accepting any layout — a row-sliced memmap view there
+        # copies just the selected rows). A non-contiguous FILE shard (a
+        # foreign Fortran-order .npy) is refused instead: ascontiguousarray
+        # would silently materialize the whole file in RAM — the opposite of
+        # the files= streaming contract.
+        def _as_rows(key, v):
+            if v.flags.c_contiguous:
+                return v
+            if files is not None:
+                raise ValueError(
+                    f"files[{key!r}]: shard is not C-contiguous "
+                    f"(Fortran-order .npy?); rewrite it row-major — copying a "
+                    f"memory-mapped shard would materialize the whole file")
+            return np.ascontiguousarray(v)
+        self._segs = {k: [_as_rows(k, v) for v in vs]
+                      for k, vs in self._segs.items()}
         self._seg_rows = [len(v) for v in self._segs[self._keys[0]]]
         self.n_rows = sum(self._seg_rows)
         if batch_size < 1 or batch_size > self.n_rows:

@@ -65,7 +65,9 @@ struct Loader {
   std::vector<uint64_t> perm;
   uint64_t cursor = 0;  // position within perm
   std::mt19937_64 rng;
-  uint64_t epochs_completed = 0;
+  // Written by the worker thread outside the slot mutex (fill_slot runs
+  // unlocked); read from Python at any time — atomic, not mutex-guarded.
+  std::atomic<uint64_t> epochs_completed{0};
 
   void refill_perm() {
     if (perm.empty()) {
@@ -221,8 +223,7 @@ int dl_next(void* handle, void** out_ptrs) {
 
 uint64_t dl_epochs_completed(void* handle) {
   auto* ld = static_cast<Loader*>(handle);
-  std::lock_guard<std::mutex> lk(ld->mu);
-  return ld->epochs_completed;
+  return ld->epochs_completed.load();
 }
 
 void dl_destroy(void* handle) {

@@ -148,6 +148,28 @@ def test_file_backed_validates_alignment(tmp_path):
                    files={"x": str(tmp_path / "x-0.npy")})
 
 
+def test_file_backed_refuses_fortran_order_shard(tmp_path):
+    """A Fortran-order .npy shard must be refused, not silently materialized:
+    ascontiguousarray on the mmap would copy the whole file into RAM."""
+    np.save(str(tmp_path / "f.npy"), np.asfortranarray(np.arange(24, dtype=np.float32)
+                                                       .reshape(6, 4)))
+    with pytest.raises(ValueError, match="C-contiguous"):
+        DataLoader(files={"f": str(tmp_path / "f.npy")}, batch_size=2)
+    # In-memory Fortran inputs still take the (cheap, explicit) copy path.
+    dl = DataLoader({"f": np.asfortranarray(np.zeros((6, 4), np.float32))},
+                    batch_size=2)
+    assert dl.next()["f"].shape == (2, 4)
+    dl.close()
+    # arrays= keeps accepting memmap VIEWS too (copies the selected rows only
+    # — the refusal is scoped to the files= streaming contract).
+    np.save(str(tmp_path / "c.npy"), np.arange(40, dtype=np.float32).reshape(10, 4))
+    mm = np.load(str(tmp_path / "c.npy"), mmap_mode="r")
+    dl = DataLoader({"c": mm[::2]}, batch_size=2, shuffle=False)
+    assert np.array_equal(dl.next()["c"],
+                          np.arange(40, dtype=np.float32).reshape(10, 4)[::2][:2])
+    dl.close()
+
+
 def test_device_prefetch_feeds_training():
     import jax.numpy as jnp
     import optax
