@@ -1,7 +1,8 @@
 """Host data pipeline (native prefetch loader + device prefetch + datasets)."""
 
-from autodist_tpu.data import movielens
+from autodist_tpu.data import movielens, text_corpus
 from autodist_tpu.data.loader import (DataLoader, device_prefetch,
                                       save_shards)
 
-__all__ = ["DataLoader", "device_prefetch", "save_shards", "movielens"]
+__all__ = ["DataLoader", "device_prefetch", "save_shards", "movielens",
+           "text_corpus"]

@@ -54,22 +54,60 @@ def main(argv=None):
                         metavar="ROWS",
                         help="Write ROWS synthetic token rows as .npy shards "
                              "into --data_dir and exit (corpus prep)")
+    parser.add_argument("--tokenize_corpus", type=str, default=None,
+                        metavar="GLOB",
+                        help="Tokenize whitespace-split text files (the 1B-word"
+                             "-benchmark layout the reference read, its "
+                             "lm1b_train.py:26-50) into token shards under "
+                             "--data_dir and exit. Word->id comes from "
+                             "--vocab_file (the published 1b_word_vocab.txt "
+                             "format) or, absent one, a frequency vocab of "
+                             "size --vocab built from the corpus itself")
+    parser.add_argument("--vocab_file", type=str, default=None,
+                        help="Vocab file for --tokenize_corpus (word in the "
+                             "first whitespace column per line, frequency-"
+                             "sorted; OOV words hash into one extra bucket)")
     args = parser.parse_args(argv)
     if args.full_softmax and args.model != "lstm":
         parser.error("--full_softmax applies to --model lstm")
     if args.write_synthetic_corpus:
         if not args.data_dir:
             parser.error("--write_synthetic_corpus needs --data_dir")
-        from autodist_tpu.data import save_shards
         import numpy as np
+
+        from autodist_tpu.data import save_shards, text_corpus
         rows = args.write_synthetic_corpus
         rng = np.random.RandomState(0)
         tokens = rng.randint(0, args.vocab, size=(rows, args.seq_len + 1),
                              ).astype(np.int32)
         files = save_shards({"tokens": tokens}, args.data_dir,
                             rows_per_shard=max(1, rows // 8))
+        # Same sidecar a tokenized corpus carries, so the train run's
+        # vocab/seq_len validation works for either prep.
+        text_corpus.write_meta(args.data_dir, vocab_size=args.vocab,
+                               seq_len=args.seq_len, rows=rows,
+                               stride=args.seq_len + 1, oov_buckets=0)
         print(f"wrote {rows} rows across {len(files['tokens'])} shards "
               f"in {args.data_dir}")
+        return None
+    if args.tokenize_corpus:
+        if not args.data_dir:
+            parser.error("--tokenize_corpus needs --data_dir")
+        from autodist_tpu.data import text_corpus
+        # Known words cap at --vocab - 1 so the total INCLUDING the OOV
+        # bucket never exceeds --vocab (the embedding size the train run
+        # defaults to).
+        if args.vocab_file:
+            vocab = text_corpus.load_vocab(args.vocab_file,
+                                           max_size=max(1, args.vocab - 1))
+        else:
+            vocab = text_corpus.build_vocab(args.tokenize_corpus,
+                                            max_size=max(1, args.vocab - 1))
+        shards = text_corpus.tokenize_to_shards(
+            args.tokenize_corpus, vocab, args.data_dir, seq_len=args.seq_len)
+        print(f"tokenized corpus -> {len(shards)} shards in {args.data_dir}; "
+              f"train with --data_dir {args.data_dir} "
+              f"--vocab {vocab.vocab_size} --seq_len {args.seq_len}")
         return None
 
     import jax
@@ -136,6 +174,13 @@ def main(argv=None):
         if head.shape[1] != args.seq_len + 1:
             parser.error(f"corpus rows are {head.shape[1]} tokens wide; the "
                          f"model needs seq_len+1 = {args.seq_len + 1}")
+        from autodist_tpu.data import text_corpus
+        meta = text_corpus.read_meta(args.data_dir)
+        if meta and meta["vocab_size"] > args.vocab:
+            parser.error(
+                f"corpus in {args.data_dir} was tokenized with vocab_size "
+                f"{meta['vocab_size']} (see tokens-meta.json) but the model "
+                f"has --vocab {args.vocab}; ids would gather out of range")
         loader = DataLoader(files={"tokens": shards},
                             batch_size=args.batch_size, shuffle=True)
         feed = device_prefetch(loader, step.runner, depth=2)
