@@ -26,6 +26,18 @@ def num_groups(channels: int, max_groups: int) -> int:
     return g
 
 
+def lm_head_logits(h, params, tied: bool = False):
+    """``[..., D] hidden -> [..., V] logits`` through the zoo's LM-head param
+    contract (same table/layout rule as :func:`fused_lm_head_nll`; same
+    compute-dtype convention as the flax head: table cast to the activation
+    dtype). Lets callers project a SLICE of positions — e.g. generation's
+    prefill needs only the last position's logits, not a [B, P, V] tensor."""
+    if tied:
+        table = params["embed"]["embedding"]          # [V, D]
+        return h @ table.astype(h.dtype).T
+    return h @ params["lm_head"]["kernel"].astype(h.dtype)  # [D, V]
+
+
 def fused_lm_head_nll(h, params, targets, tied: bool = False):
     """Per-token NLL [B, T] through the fused pallas head+loss for the zoo's
     flax LM-head convention — THE single definition of which param is the head

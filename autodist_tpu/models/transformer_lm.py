@@ -75,7 +75,7 @@ class MultiHeadAttention(nn.Module):
     config: TransformerLMConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, decode: bool = False):
         cfg = self.config
         head_dim = cfg.d_model // cfg.n_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -83,7 +83,39 @@ class MultiHeadAttention(nn.Module):
             param_dtype=jnp.float32, name=name, use_bias=False)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
 
-        if cfg.attention_impl == "flash":
+        if decode:
+            # Autoregressive KV cache (the flax "cache" collection): keys and
+            # values persist at their global positions across apply() calls, so
+            # each decode step computes q/k/v for ITS tokens only and attends
+            # over everything cached — a [total, total] score matrix never
+            # materializes. Static shapes: the cache is max_len long from the
+            # first step; masking (not shapes) encodes how much is live.
+            # attention_impl is deliberately ignored here — flash/ring pay off
+            # on long dense score matrices, which decode never builds.
+            batch, chunk = x.shape[0], x.shape[1]
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (batch, cfg.max_len, cfg.n_heads, head_dim),
+                               cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (batch, cfg.max_len, cfg.n_heads, head_dim),
+                               cfg.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice_in_dim(
+                ck.value, k.astype(cfg.dtype), idx, axis=1)
+            cv.value = jax.lax.dynamic_update_slice_in_dim(
+                cv.value, v.astype(cfg.dtype), idx, axis=1)
+            ci.value = idx + chunk
+            # Each query (global position idx + i) sees keys [0, idx + i]:
+            # causal within the chunk AND excludes the cache's unwritten tail.
+            q_pos = idx + jnp.arange(chunk)
+            dec_mask = jnp.where(
+                jnp.arange(cfg.max_len)[None, :] <= q_pos[:, None],
+                jnp.zeros((), cfg.dtype), jnp.full((), -1e9, cfg.dtype))
+            ctx = dot_product_attention(q, ck.value, cv.value,
+                                        dec_mask[None, None], cfg.dtype)
+        elif cfg.attention_impl == "flash":
             from autodist_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
         elif cfg.attention_impl == "blockwise":
@@ -119,10 +151,10 @@ class Block(nn.Module):
     config: TransformerLMConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, decode: bool = False):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
-        x = x + MultiHeadAttention(cfg, name="attn")(h, mask)
+        x = x + MultiHeadAttention(cfg, name="attn")(h, mask, decode=decode)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
                      name="mlp_in", use_bias=False)(h)
@@ -136,12 +168,16 @@ class TransformerLM(nn.Module):
     config: TransformerLMConfig
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0, return_hidden=False):
+    def __call__(self, tokens, pos_offset=0, return_hidden=False,
+                 decode: bool = False):
         """``pos_offset``: global position of ``tokens[:, 0]`` — nonzero when this
         call sees one sequence shard (the sequence-parallel path passes the ring
-        offset so position embeddings stay globally correct).
+        offset so position embeddings stay globally correct) and during
+        autoregressive decoding (the generation loop passes the write position).
         ``return_hidden``: skip the vocab projection and return the final hidden
-        states (the fused-head loss owns the projection)."""
+        states (the fused-head loss owns the projection).
+        ``decode``: autoregressive KV-cache mode (run under
+        ``mutable=["cache"]``; see :func:`generate`)."""
         cfg = self.config
         _, length = tokens.shape
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -152,11 +188,17 @@ class TransformerLM(nn.Module):
         x = emb(tokens) + pos_slice[None].astype(cfg.dtype)
         mask = causal_mask(length, cfg.dtype)
 
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
-        for i in range(cfg.n_layers):
-            x = block(cfg, name=f"block_{i}")(x, mask)
+        if cfg.remat and not decode:
+            # remat trades recompute for activation memory in training; decode
+            # steps keep no activations worth trading. The remat'd call must
+            # not see the decode kwarg at all: lifted checkpoint would trace
+            # the bool into an abstract value and break the Python branch.
+            for i in range(cfg.n_layers):
+                x = nn.remat(Block, static_argnums=())(
+                    cfg, name=f"block_{i}")(x, mask)
+        else:
+            for i in range(cfg.n_layers):
+                x = Block(cfg, name=f"block_{i}")(x, mask, decode=decode)
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Head matmul in compute dtype: on TPU an f32 [B*S, d, V] matmul runs at
@@ -212,6 +254,89 @@ def make_loss_fn(model: TransformerLM) -> Callable:
         return nll.mean()
 
     return loss_fn
+
+
+def sample_logits(logits: jax.Array, key: jax.Array, temperature: float = 0.0,
+                  top_k: int = 0) -> jax.Array:
+    """One sampling step over ``[B, vocab]`` logits -> ``[B]`` int32 tokens.
+
+    ``temperature=0`` is greedy argmax (``key`` unused); otherwise logits are
+    scaled by ``1/temperature`` and, with ``top_k > 0``, truncated to the k
+    best before the categorical draw. f32 throughout — bf16 logit gaps near
+    the distribution tail would quantize away."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive generation with a KV cache: ``[B, P]`` int32 prompt ->
+    ``[B, max_new_tokens]`` sampled continuation.
+
+    TPU-shaped throughout: one full-prompt prefill apply writes the cache
+    (position embeddings and causality handled by the decode path), then a
+    single ``lax.scan`` of per-token steps — static shapes, no Python loop
+    over tokens, the cache donated through the carry. Works under ``jit``
+    (wrap with ``jax.jit(..., static_argnums=(0, 3, 4, 5))`` or close over
+    the statics); sharded/replicated params work as placed — XLA inserts any
+    collectives. The reference had no generation path at all (serving =
+    SavedModel export); this is the TPU-native inference loop its exported
+    models would still need.
+    """
+    cfg = model.config
+    batch, prompt_len = prompt.shape
+    if prompt_len < 1:
+        raise ValueError("prompt must have at least one token")
+    if prompt_len + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_len ({cfg.max_len})")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    # Prefill: the whole prompt in one decode apply (the chunked cache write).
+    # return_hidden + a one-position head projection: only the LAST prompt
+    # position's logits are needed, so the [B, P, vocab] tensor (and its
+    # P-times-larger head matmul) never materializes.
+    from autodist_tpu.models.common import lm_head_logits
+    hidden, variables = model.apply({"params": params}, prompt, pos_offset=0,
+                                    decode=True, return_hidden=True,
+                                    mutable=["cache"])
+    last = lm_head_logits(hidden[:, -1], params, tied=cfg.tied_output)
+    keys = jax.random.split(rng, max_new_tokens)
+    first = sample_logits(last, keys[0], temperature, top_k)
+
+    def step(carry, key):
+        cache, tok, pos = carry
+        logits, variables = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], pos_offset=pos,
+            decode=True, mutable=["cache"])
+        nxt = sample_logits(logits[:, 0], key, temperature, top_k)
+        return (variables["cache"], nxt, pos + 1), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    init = (variables["cache"], first, jnp.asarray(prompt_len, jnp.int32))
+    _, rest = jax.lax.scan(step, init, keys[1:])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def make_generate_fn(model: TransformerLM, max_new_tokens: int,
+                     temperature: float = 0.0, top_k: int = 0) -> Callable:
+    """``jit``-compiled ``f(params, prompt, rng=None) -> [B, max_new_tokens]``
+    closing over the statics (one compile per prompt shape)."""
+    def f(params, prompt, rng=None):
+        return generate(model, params, prompt, max_new_tokens,
+                        temperature=temperature, top_k=top_k, rng=rng)
+    return jax.jit(f)
 
 
 def init_params(config: TransformerLMConfig, rng: Optional[jax.Array] = None,
