@@ -26,6 +26,23 @@ def num_groups(channels: int, max_groups: int) -> int:
     return g
 
 
+def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0):
+    """One sampling step over ``[B, vocab]`` logits -> ``[B]`` int32 tokens.
+
+    ``temperature=0`` is greedy argmax (``key`` unused); otherwise logits are
+    scaled by ``1/temperature`` and, with ``top_k > 0``, truncated to the k
+    best before the categorical draw. f32 throughout — bf16 logit gaps near
+    the distribution tail would quantize away."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def lm_head_logits(h, params, tied: bool = False):
     """``[..., D] hidden -> [..., V] logits`` through the zoo's LM-head param
     contract (same table/layout rule as :func:`fused_lm_head_nll`; same

@@ -50,16 +50,31 @@ class LSTMLM(nn.Module):
     config: LSTMLMConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode: bool = False):
+        """``decode``: persist each layer's (c, h) carry in the ``cache``
+        collection across apply() calls (run under ``mutable=["cache"]``), so
+        autoregressive generation feeds one token at a time without re-running
+        the prefix — the LSTM analogue of the Transformer's KV cache (state is
+        O(hidden), not O(sequence))."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.emb_dim, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed")(tokens)
         for i in range(cfg.n_layers):
             # nn.RNN lowers to lax.scan over the sequence axis; the cell's four
             # gates are one fused matmul per step.
-            x = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden_dim, dtype=cfg.dtype,
-                                            param_dtype=jnp.float32),
-                       name=f"lstm_{i}")(x)
+            rnn = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden_dim, dtype=cfg.dtype,
+                                              param_dtype=jnp.float32),
+                         name=f"lstm_{i}")
+            if decode:
+                zeros = lambda: (  # noqa: E731 — (c, h), the cell carry pair
+                    jnp.zeros((x.shape[0], cfg.hidden_dim), cfg.dtype),
+                    jnp.zeros((x.shape[0], cfg.hidden_dim), cfg.dtype))
+                carry_var = self.variable("cache", f"carry_{i}", zeros)
+                carry, x = rnn(x, initial_carry=carry_var.value,
+                               return_carry=True)
+                carry_var.value = carry
+            else:
+                x = rnn(x)
         return x  # [B, T, hidden]
 
 
@@ -69,9 +84,9 @@ class LSTMLMWithHead(nn.Module):
     config: LSTMLMConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode: bool = False):
         cfg = self.config
-        h = LSTMLM(cfg, name="lm")(tokens)
+        h = LSTMLM(cfg, name="lm")(tokens, decode=decode)
         # Parameters are declared here; the loss fn gathers rows out of them.
         self.param("softmax_w", nn.initializers.normal(0.02),
                    (cfg.vocab_size, cfg.hidden_dim), jnp.float32)
@@ -165,6 +180,60 @@ def make_fused_full_softmax_loss_fn(model: LSTMLMWithHead) -> Callable:
         return nll.mean()
 
     return loss_fn
+
+
+def generate(model: LSTMLMWithHead, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive generation: ``[B, P]`` int32 prompt ->
+    ``[B, max_new_tokens]`` continuation, full-softmax head.
+
+    Same shape as the Transformer's :func:`~autodist_tpu.models.
+    transformer_lm.generate`: one prefill apply threads the whole prompt
+    through the recurrence (the carry cache holds O(hidden) state — no
+    sequence-length cache at all), then a single ``lax.scan`` of per-token
+    steps. Works at the giant-vocab scale too: the per-step head is one
+    ``[B, V]`` logits row, never a sequence of them."""
+    from autodist_tpu.models.common import sample_logits
+    if prompt.shape[1] < 1:
+        raise ValueError("prompt must have at least one token")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def head(h_last):                       # [B, hidden] -> [B, V] f32
+        w, b = params["softmax_w"], params["softmax_b"]
+        return jnp.matmul(h_last, w.T.astype(h_last.dtype),
+                          preferred_element_type=jnp.float32) + b
+
+    h, variables = model.apply({"params": params}, prompt, decode=True,
+                               mutable=["cache"])
+    keys = jax.random.split(rng, max_new_tokens)
+    first = sample_logits(head(h[:, -1]), keys[0], temperature, top_k)
+
+    def step(carry, key):
+        cache, tok = carry
+        h, variables = model.apply({"params": params, "cache": cache},
+                                   tok[:, None], decode=True,
+                                   mutable=["cache"])
+        nxt = sample_logits(head(h[:, 0]), key, temperature, top_k)
+        return (variables["cache"], nxt), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    _, rest = jax.lax.scan(step, (variables["cache"], first), keys[1:])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def make_generate_fn(model: LSTMLMWithHead, max_new_tokens: int,
+                     temperature: float = 0.0, top_k: int = 0) -> Callable:
+    """``jit``-compiled ``f(params, prompt, rng=None)`` closing over the
+    statics (one compile per prompt shape) — mirrors
+    :func:`autodist_tpu.models.transformer_lm.make_generate_fn`."""
+    def f(params, prompt, rng=None):
+        return generate(model, params, prompt, max_new_tokens,
+                        temperature=temperature, top_k=top_k, rng=rng)
+    return jax.jit(f)
 
 
 def init_params(config: LSTMLMConfig, rng: Optional[jax.Array] = None,

@@ -256,22 +256,9 @@ def make_loss_fn(model: TransformerLM) -> Callable:
     return loss_fn
 
 
-def sample_logits(logits: jax.Array, key: jax.Array, temperature: float = 0.0,
-                  top_k: int = 0) -> jax.Array:
-    """One sampling step over ``[B, vocab]`` logits -> ``[B]`` int32 tokens.
-
-    ``temperature=0`` is greedy argmax (``key`` unused); otherwise logits are
-    scaled by ``1/temperature`` and, with ``top_k > 0``, truncated to the k
-    best before the categorical draw. f32 throughout — bf16 logit gaps near
-    the distribution tail would quantize away."""
-    logits = logits.astype(jnp.float32)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+# Canonical definition in models/common.py (shared with the LSTM family);
+# re-exported here because generation on the flagship is this module's API.
+from autodist_tpu.models.common import sample_logits  # noqa: E402,F401
 
 
 def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
