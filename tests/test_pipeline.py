@@ -262,3 +262,133 @@ def test_pipelined_rejects_mesh_stage_mismatch():
     f = pipelined(lambda p, x: x, n_stages=4, mesh=mesh)
     with mesh, pytest.raises(ValueError, match="pipe"):
         jax.jit(lambda w, x: f(w, x))(jnp.zeros((4, 2, 2)), jnp.zeros((2, 2, 2)))
+
+
+def test_interleaved_matches_plain_1f1b():
+    """Interleaved 1F1B (v chunks per device) returns the SAME loss and
+    gradients as plain 1F1B run with one device per virtual stage — only the
+    device mapping and schedule differ."""
+    from autodist_tpu.parallel.mesh import build_mesh
+    from autodist_tpu.parallel.pipeline import (interleave_chunk_layout,
+                                                interleaved_value_and_grad)
+    s, v, m = 2, 2, 6
+    V = s * v
+    w, head, x_mb, t_mb, stage_fn, tail_fn = _onef_oneb_setup(V, m, seed=2)
+
+    plain_mesh = build_mesh(axes={"pipe": V, "data": -1})
+    f_plain = pipelined_value_and_grad(stage_fn, tail_fn, V, mesh=plain_mesh)
+    with plain_mesh:
+        loss_p, gs_p, gt_p, gx_p = jax.jit(f_plain)(w, head, x_mb, t_mb)
+
+    il_mesh = build_mesh(axes={"pipe": s, "data": -1})
+    f_il = interleaved_value_and_grad(stage_fn, tail_fn, s, v, mesh=il_mesh)
+    w_dev = interleave_chunk_layout(w, s, v)          # virtual -> device-major
+    with il_mesh:
+        loss_i, gs_i, gt_i, gx_i = jax.jit(f_il)(w_dev, head, x_mb, t_mb)
+    gs_i = interleave_chunk_layout(gs_i, s, v, inverse=True)
+
+    np.testing.assert_allclose(float(loss_i), float(loss_p), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs_i), np.asarray(gs_p),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gt_i), np.asarray(gt_p),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_i), np.asarray(gx_p),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_interleaved_deeper_and_chunks_one_degenerates():
+    """v=4 chunks on 2 devices (8 virtual stages); and n_chunks=1 must equal
+    plain 1F1B exactly (same schedule by construction)."""
+    from autodist_tpu.parallel.mesh import build_mesh
+    from autodist_tpu.parallel.pipeline import (interleave_chunk_layout,
+                                                interleaved_value_and_grad)
+    s, v, m = 2, 4, 4
+    V = s * v
+    w, head, x_mb, t_mb, stage_fn, tail_fn = _onef_oneb_setup(V, m, seed=5)
+    mesh = build_mesh(axes={"pipe": s, "data": -1})
+    f_il = interleaved_value_and_grad(stage_fn, tail_fn, s, v, mesh=mesh)
+    with mesh:
+        loss_i, gs_i, _, gx_i = jax.jit(f_il)(
+            interleave_chunk_layout(w, s, v), head, x_mb, t_mb)
+    gs_i = interleave_chunk_layout(gs_i, s, v, inverse=True)
+
+    # Sequential oracle over all V stages.
+    def ref(w, head, x, tgt):
+        def one(xk, tk):
+            h = xk
+            for i in range(V):
+                h = stage_fn(w[i:i + 1], h)   # stage_fn takes a [1, ...] block
+            return tail_fn(head, h, tk)
+        return jax.vmap(one)(x, tgt).mean()
+    l_ref, (gs_r, gx_r) = jax.jit(jax.value_and_grad(
+        ref, argnums=(0, 2)))(w, head, x_mb, t_mb)
+    np.testing.assert_allclose(float(loss_i), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs_i), np.asarray(gs_r),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_i), np.asarray(gx_r),
+                               rtol=1e-4, atol=1e-6)
+
+    # n_chunks=1: identical schedule to plain 1F1B.
+    s4 = 4
+    w4, head4, x4, t4, stage_fn, tail_fn = _onef_oneb_setup(s4, 4, seed=7)
+    mesh4 = build_mesh(axes={"pipe": s4, "data": -1})
+    f_plain = pipelined_value_and_grad(stage_fn, tail_fn, s4, mesh=mesh4)
+    f_one = interleaved_value_and_grad(stage_fn, tail_fn, s4, 1, mesh=mesh4)
+    with mesh4:
+        loss_p, gs_p, gt_p, gx_p = jax.jit(f_plain)(w4, head4, x4, t4)
+        loss_o, gs_o, gt_o, gx_o = jax.jit(f_one)(w4, head4, x4, t4)
+    np.testing.assert_allclose(float(loss_o), float(loss_p), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gs_o), np.asarray(gs_p), rtol=1e-5)
+
+
+def test_interleaved_wide_mesh_and_validation():
+    """S=4 with v=2 (wide mesh x chunks); non-divisible microbatch counts are
+    refused (a ragged final group would silently skip/double-process pairs);
+    scalar stage-params leaves get the clear leading-dim error."""
+    import pytest
+
+    from autodist_tpu.parallel.mesh import build_mesh
+    from autodist_tpu.parallel.pipeline import (interleave_chunk_layout,
+                                                interleaved_value_and_grad)
+    s, v, m = 4, 2, 8
+    V = s * v
+    w, head, x_mb, t_mb, stage_fn, tail_fn = _onef_oneb_setup(V, m, seed=9)
+    mesh = build_mesh(axes={"pipe": s, "data": -1})
+    f_il = interleaved_value_and_grad(stage_fn, tail_fn, s, v, mesh=mesh)
+    with mesh:
+        loss_i, gs_i, _, gx_i = jax.jit(f_il)(
+            interleave_chunk_layout(w, s, v), head, x_mb, t_mb)
+    gs_i = interleave_chunk_layout(gs_i, s, v, inverse=True)
+
+    def ref(w, head, x, tgt):
+        def one(xk, tk):
+            h = xk
+            for i in range(V):
+                h = stage_fn(w[i:i + 1], h)
+            return tail_fn(head, h, tk)
+        return jax.vmap(one)(x, tgt).mean()
+    l_ref, (gs_r, gx_r) = jax.jit(jax.value_and_grad(
+        ref, argnums=(0, 2)))(w, head, x_mb, t_mb)
+    np.testing.assert_allclose(float(loss_i), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs_i), np.asarray(gs_r),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_i), np.asarray(gx_r),
+                               rtol=1e-4, atol=1e-6)
+
+    with mesh, pytest.raises(ValueError, match="divisible by n_stages"):
+        jax.jit(f_il)(interleave_chunk_layout(w, s, v), head,
+                      x_mb[:5], t_mb[:5])
+    with mesh, pytest.raises(ValueError, match="leading dim"):
+        jax.jit(f_il)({"w": interleave_chunk_layout(w, s, v),
+                       "gain": jnp.ones(())}, head, x_mb, t_mb)
+
+
+def test_interleave_chunk_layout_roundtrip():
+    from autodist_tpu.parallel.pipeline import interleave_chunk_layout
+    x = jnp.arange(6 * 3).reshape(6, 3)           # V=6 rows
+    fwd = interleave_chunk_layout(x, n_stages=3, n_chunks=2)
+    # Device-major: row r*v + j = virtual j*S + r.
+    expect = [0 * 3 + 0, 1 * 3 + 0, 0 * 3 + 1, 1 * 3 + 1, 0 * 3 + 2, 1 * 3 + 2]
+    np.testing.assert_array_equal(np.asarray(fwd[:, 0]) // 3, expect)
+    back = interleave_chunk_layout(fwd, n_stages=3, n_chunks=2, inverse=True)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
