@@ -32,13 +32,23 @@ class PipelineLMConfig:
     max_len: int = 1024
     n_stages: int = 4
     num_microbatches: int = 4
+    # Interleaved 1F1B: each device runs n_chunks virtual stages (layer groups
+    # c mod n_stages == rank) instead of one contiguous group — thinner
+    # pipeline ticks, ~half the fill/drain bubble (parallel/pipeline docs).
+    # 1 = plain contiguous stages (GPipe / 1F1B).
+    n_chunks: int = 1
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
-        if self.n_layers % self.n_stages:
-            raise ValueError("n_layers must be divisible by n_stages")
+        if self.n_layers % (self.n_stages * self.n_chunks):
+            raise ValueError(
+                "n_layers must be divisible by n_stages * n_chunks")
+        if self.n_chunks > 1 and self.num_microbatches % self.n_stages:
+            raise ValueError(
+                "interleaved schedule (n_chunks > 1) needs num_microbatches "
+                "divisible by n_stages")
 
 
 def _layer_norm(x, scale, bias):
@@ -90,11 +100,59 @@ def _embed_microbatches(cfg: PipelineLMConfig, params, tokens):
     return x.reshape(b // m, m, t, cfg.d_model).swapaxes(0, 1)
 
 
-def _stage_groups(cfg: PipelineLMConfig, block_params):
-    """[L, ...] block stacks -> [S, L/S, ...] stage groups (contiguous layers)."""
-    lps = cfg.n_layers // cfg.n_stages
+def _stage_groups(cfg: PipelineLMConfig, block_params, n_groups: int = None):
+    """[L, ...] block stacks -> [G, L/G, ...] stage groups (contiguous layers;
+    G defaults to n_stages — the interleaved path passes S*v)."""
+    n_groups = cfg.n_stages if n_groups is None else n_groups
+    lps = cfg.n_layers // n_groups
     return jax.tree_util.tree_map(
-        lambda a: a.reshape(cfg.n_stages, lps, *a.shape[1:]), block_params)
+        lambda a: a.reshape(n_groups, lps, *a.shape[1:]), block_params)
+
+
+def layer_execution_order(cfg: PipelineLMConfig):
+    """Stored-row -> execution-position mapping for the block stack.
+
+    ``n_chunks == 1``: identity — stored layer i executes i-th. ``n_chunks >
+    1``: blocks are STORED in device-major chunk order (device r's rows are
+    contiguous, so the plan's ``P("pipe")`` sharding gives each device
+    exactly its chunks with ZERO per-step layout traffic — the permutation
+    happens once, at init); stored group ``r*v + j`` holds execution group
+    ``j*S + r`` (the one shared permutation, ``parallel.pipeline.chunk_perm``,
+    expanded from groups to layers). Returns ``order`` with
+    ``order[stored_row] = execution_position``.
+
+    CHECKPOINT CAVEAT: this makes the stored block stack's meaning depend on
+    ``(n_stages, n_chunks)``. A checkpoint written under one pipeline config
+    restores bit-identically only into the SAME config; to change configs,
+    round-trip through :func:`blocks_to_execution_order` /
+    :func:`blocks_from_execution_order` (execution order is the
+    config-independent canonical form)."""
+    from autodist_tpu.parallel.pipeline import chunk_perm
+    lps = cfg.n_layers // (cfg.n_stages * cfg.n_chunks)
+    order = []
+    for c in chunk_perm(cfg.n_stages, cfg.n_chunks):   # stored g reads virtual c
+        order.extend(range(c * lps, (c + 1) * lps))
+    return order
+
+
+def _execution_to_stored(cfg: PipelineLMConfig):
+    """index array: stored row i = execution-order row order[i]."""
+    return np.asarray(layer_execution_order(cfg))
+
+
+def blocks_to_execution_order(cfg: PipelineLMConfig, blocks):
+    """Stored (device-major) block stack -> execution-order stack (the
+    config-independent layout; use before moving a checkpoint between
+    pipeline configs)."""
+    inv = np.argsort(_execution_to_stored(cfg))
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, inv, axis=0), blocks)
+
+
+def blocks_from_execution_order(cfg: PipelineLMConfig, blocks):
+    """Execution-order block stack -> this config's stored (device-major)
+    layout (inverse of :func:`blocks_to_execution_order`)."""
+    idx = _execution_to_stored(cfg)
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, idx, axis=0), blocks)
 
 
 def _make_stage_fn(cfg: PipelineLMConfig):
@@ -127,7 +185,13 @@ class PipelineLM:
         cfg = self.config
         b, t = tokens.shape
         x_mb = _embed_microbatches(cfg, params, tokens)
-        stage_params = _stage_groups(cfg, params["blocks"])
+        blocks = params["blocks"]
+        if cfg.n_chunks > 1:
+            # GPipe needs contiguous execution-order stage groups; with
+            # device-major storage that costs one gather HERE (the GPipe
+            # comparison path), keeping the 1F1B training step permute-free.
+            blocks = blocks_to_execution_order(cfg, blocks)
+        stage_params = _stage_groups(cfg, blocks)
         y_mb = pipelined(_make_stage_fn(cfg), cfg.n_stages,
                          axis=const.MESH_AXIS_PIPE)(stage_params, x_mb)
         h = y_mb.swapaxes(0, 1).reshape(b, t, cfg.d_model)
@@ -153,8 +217,14 @@ def make_onef_oneb_value_and_grad(model: PipelineLM):
     norm+head+loss is the in-schedule tail at the last stage. Gradients match
     ``jax.grad(make_loss_fn(model))`` exactly; activation memory is
     O(n_stages) instead of growing with ``num_microbatches`` (see
-    ``parallel/pipeline``). Feed the result to any optax optimizer."""
-    from autodist_tpu.parallel.pipeline import pipelined_value_and_grad
+    ``parallel/pipeline``). With ``cfg.n_chunks > 1`` the INTERLEAVED
+    schedule runs — layer group ``c`` on device ``c mod n_stages``, ~half the
+    fill/drain bubble — behind the same ``f(params, batch)`` surface: blocks
+    are stored device-major (:func:`layer_execution_order`), so the step
+    performs no layout permutes at all. Feed the result to any optax
+    optimizer."""
+    from autodist_tpu.parallel.pipeline import (interleaved_value_and_grad,
+                                                pipelined_value_and_grad)
 
     cfg = model.config
 
@@ -170,17 +240,29 @@ def make_onef_oneb_value_and_grad(model: PipelineLM):
         pre_params = {"embed": params["embed"], "pos": params["pos"]}
         x_mb, vjp_pre = jax.vjp(pre, pre_params, inputs)
         targets_mb = targets.reshape(b // m, m, t).swapaxes(0, 1)
-        stage_params = _stage_groups(cfg, params["blocks"])
         tail_params = {"ln_f_s": params["ln_f_s"], "ln_f_b": params["ln_f_b"],
                        "head": params["head"]}
 
         def tail_fn(tp, y, tgt):
             return _nll(_head_logits(tp, y), tgt).mean()
 
-        loss, gs, gt, gx = pipelined_value_and_grad(
-            _make_stage_fn(cfg), tail_fn, cfg.n_stages,
-            axis=const.MESH_AXIS_PIPE)(
-                stage_params, tail_params, x_mb, targets_mb)
+        if cfg.n_chunks > 1:
+            # Blocks are STORED device-major (layer_execution_order), so the
+            # grouped view is already the schedule's layout: no per-step
+            # permute, no cross-device layout traffic — grads come back in
+            # the same stored order the optimizer state uses.
+            n_groups = cfg.n_stages * cfg.n_chunks
+            stage_params = _stage_groups(cfg, params["blocks"], n_groups)
+            loss, gs, gt, gx = interleaved_value_and_grad(
+                _make_stage_fn(cfg), tail_fn, cfg.n_stages, cfg.n_chunks,
+                axis=const.MESH_AXIS_PIPE)(
+                    stage_params, tail_params, x_mb, targets_mb)
+        else:
+            stage_params = _stage_groups(cfg, params["blocks"])
+            loss, gs, gt, gx = pipelined_value_and_grad(
+                _make_stage_fn(cfg), tail_fn, cfg.n_stages,
+                axis=const.MESH_AXIS_PIPE)(
+                    stage_params, tail_params, x_mb, targets_mb)
         d_pre, _ = vjp_pre(gx.astype(x_mb.dtype))
         grads = {
             "embed": d_pre["embed"], "pos": d_pre["pos"],
@@ -225,13 +307,16 @@ def init_params(config: PipelineLMConfig, rng: Optional[jax.Array] = None):
 
 def sequential_apply(model: PipelineLM, params, tokens):
     """Reference forward without the pipeline (for parity tests): same math, plain
-    layer loop."""
+    layer loop in EXECUTION order (stored order differs when n_chunks > 1,
+    see :func:`layer_execution_order`)."""
     cfg = model.config
     _, t = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     x = x + params["pos"][None, :t, :].astype(cfg.dtype)
+    blocks = blocks_to_execution_order(cfg, params["blocks"]) \
+        if cfg.n_chunks > 1 else params["blocks"]
     for i in range(cfg.n_layers):
-        layer_p = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+        layer_p = jax.tree_util.tree_map(lambda a, i=i: a[i], blocks)
         x = _block_apply(layer_p, x, cfg)
     x = _layer_norm(x, params["ln_f_s"], params["ln_f_b"])
     return x.astype(jnp.float32) @ params["head"]

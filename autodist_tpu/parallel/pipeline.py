@@ -122,9 +122,7 @@ def onef_oneb_apply(stage_fn: Callable, tail_fn: Callable, stage_params: PyTree,
     stage, hence the O(n_stages) activation footprint.
     """
     n_stages = jax.lax.psum(1, axis)
-    rank = jax.lax.axis_index(axis)
     n_mb = x_mb.shape[0]
-    last = n_stages - 1
 
     def mb_at(tree, k):
         return jax.tree_util.tree_map(
@@ -154,84 +152,13 @@ def onef_oneb_apply(stage_fn: Callable, tail_fn: Callable, stage_params: PyTree,
                 jax.tree_util.tree_map(lambda g: g * scale, gt),
                 gx * scale)
 
-    ring_size = 2 * n_stages - 1  # max input lifetime + 1 (rank 0's window)
-    fwd_pairs = [(i, i + 1) for i in range(n_stages - 1)]
-    bwd_pairs = [(i + 1, i) for i in range(n_stages - 1)]
-
-    def tick(carry, t):
-        a_recv, g_recv, ring, gs, gt, gx_buf, loss_acc = carry
-
-        # ---- F slot: forward of microbatch t - rank ----------------------
-        k_f = t - rank
-        f_valid = (k_f >= 0) & (k_f < n_mb)
-        x_in = jnp.where(rank == 0,
-                         jax.lax.dynamic_index_in_dim(
-                             x_mb, jnp.clip(k_f, 0, n_mb - 1), 0,
-                             keepdims=False),
-                         a_recv)
-        y = stage_fn(stage_params, x_in)
-        slot_f = jnp.mod(jnp.clip(k_f, 0, None), ring_size)
-        kept = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
-        ring = jax.lax.dynamic_update_index_in_dim(
-            ring, jnp.where(f_valid, x_in, kept), slot_f, 0)
-
-        # ---- B slot: backward of microbatch t - (2S - 2 - rank) ----------
-        k_b = t - (2 * n_stages - 2 - rank)
-        b_valid = (k_b >= 0) & (k_b < n_mb)
-        slot_b = jnp.mod(jnp.clip(k_b, 0, None), ring_size)
-        x_saved = jax.lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
-        # Recompute the stage forward from its saved INPUT (remat): vjp
-        # residuals cannot live in a scan carry, and this is what keeps the
-        # live set O(n_stages) instead of O(num_microbatches).
-        y_b, vjp = jax.vjp(stage_fn, stage_params, x_saved)
-        tgt = mb_at(targets_mb, jnp.clip(k_b, 0, n_mb - 1))
-        loss_k, (d_tail, d_y) = jax.value_and_grad(
-            tail_fn, argnums=(0, 1))(tail_params, y_b, tgt)
-        g_y = jnp.where(rank == last, d_y, g_recv)
-        d_stage, d_x = vjp(g_y)
-        # b_valid suppresses fill/drain garbage; RANK ownership (loss and
-        # tail grads belong to the last stage, x grads to rank 0) is applied
-        # once, at the psum broadcast after the scan.
-        gs = jax.tree_util.tree_map(
-            lambda acc, g: acc + jnp.where(b_valid, g, 0), gs, d_stage)
-        gt = jax.tree_util.tree_map(
-            lambda acc, g: acc + jnp.where(b_valid, g, 0), gt, d_tail)
-        loss_acc = loss_acc + jnp.where(b_valid, loss_k, 0.0)
-        k_x = jnp.clip(k_b, 0, n_mb - 1)
-        prev = jax.lax.dynamic_index_in_dim(gx_buf, k_x, 0, keepdims=False)
-        gx_buf = jax.lax.dynamic_update_index_in_dim(
-            gx_buf, jnp.where(b_valid, d_x, prev), k_x, 0)
-
-        # ---- handoffs land next tick (F chain r->r+1, B chain r->r-1) ----
-        a_next = jax.lax.ppermute(y, axis, fwd_pairs)
-        g_next = jax.lax.ppermute(d_x, axis, bwd_pairs)
-        return (a_next, g_next, ring, gs, gt, gx_buf, loss_acc), None
-
-    zeros_s = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
-    zeros_t = jax.tree_util.tree_map(jnp.zeros_like, tail_params)
-    init = (
-        jnp.zeros_like(x_mb[0]),                                   # a_recv
-        jnp.zeros_like(x_mb[0]),                                   # g_recv
-        jnp.zeros((ring_size,) + x_mb.shape[1:], x_mb.dtype),      # ring
-        zeros_s, zeros_t,
-        jnp.zeros_like(x_mb),                                      # gx_buf
-        jnp.zeros(()),                                             # loss
-    )
-    n_ticks = n_mb + 2 * (n_stages - 1)
-    (_, _, _, gs, gt, gx_buf, loss_acc), _ = jax.lax.scan(
-        tick, init, jnp.arange(n_ticks))
-
-    scale = 1.0 / n_mb
-    # Loss/tail grads/x grads live only at their owning rank; psum with the
-    # ownership mask broadcasts them (stage grads stay per-rank shards).
-    last_mask = (rank == last).astype(loss_acc.dtype)
-    loss = jax.lax.psum(loss_acc * last_mask, axis) * scale
-    gt = jax.tree_util.tree_map(
-        lambda g: jax.lax.psum(g * (rank == last).astype(g.dtype), axis)
-        * scale, gt)
-    gx = jax.lax.psum(gx_buf * (rank == 0).astype(gx_buf.dtype), axis) * scale
-    gs = jax.tree_util.tree_map(lambda g: g * scale, gs)
-    return loss, gs, gt, gx
+    # General case: exactly the interleaved schedule with one chunk per
+    # device — the slot arithmetic, ring sizing, delay offset, masks, and
+    # ownership psums all reduce to the plain-1F1B formulas at n_chunks=1
+    # (pinned by tests), so ONE tick body serves both schedules.
+    return interleaved_onef_oneb_apply(stage_fn, tail_fn, stage_params,
+                                       tail_params, x_mb, targets_mb,
+                                       n_chunks=1, axis=axis)
 
 
 def interleaved_onef_oneb_apply(stage_fn: Callable, tail_fn: Callable,
@@ -281,10 +208,15 @@ def interleaved_onef_oneb_apply(stage_fn: Callable, tail_fn: Callable,
     # Max saved-input lifetime: T_b - T_f at r=0, j=0 (see docstring), +1.
     ring_size = 2 * (n_stages - 1) + 2 * (v - 1) * n_stages + 1
     delay = 2 * (n_stages - 1) + (v - 1) * n_stages - rank
-    # Ring wraps included: forward S-1 -> 0 carries a microbatch into its next
-    # chunk group; backward 0 -> S-1 carries the grad back across it.
-    fwd_pairs = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    bwd_pairs = [((i + 1) % n_stages, i) for i in range(n_stages)]
+    # Ring wraps (forward S-1 -> 0, backward 0 -> S-1) only exist to carry a
+    # microbatch across chunk-group transitions; at v=1 there are none and
+    # the wrap payloads would be pure dead inter-device traffic every tick.
+    if v > 1:
+        fwd_pairs = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_pairs = [((i + 1) % n_stages, i) for i in range(n_stages)]
+    else:
+        fwd_pairs = [(i, i + 1) for i in range(n_stages - 1)]
+        bwd_pairs = [(i + 1, i) for i in range(n_stages - 1)]
 
     def decompose_f(idx):
         g, rem = idx // sv, idx % sv
@@ -328,9 +260,26 @@ def interleaved_onef_oneb_apply(stage_fn: Callable, tail_fn: Callable,
         params_b = chunk_at(stage_params, j_b)
         y_b, vjp = jax.vjp(stage_fn, params_b, x_saved)
         tgt = mb_at(targets_mb, jnp.clip(m_b, 0, n_mb - 1))
-        loss_k, (d_tail, d_y) = jax.value_and_grad(
-            tail_fn, argnums=(0, 1))(tail_params, y_b, tgt)
         is_last = c_b == sv - 1                          # loss-owning stage
+
+        # The tail (head matmul + loss + its VJP — the vocab-sized work for
+        # LM models) contributes ONLY at valid last-stage slots; lax.cond
+        # skips it elsewhere instead of computing-then-masking — without the
+        # gate every rank/chunk/tick would pay it, and interleaving
+        # multiplies the tick count by v.
+        def run_tail(args):
+            tp, y, t_ = args
+            return jax.value_and_grad(tail_fn, argnums=(0, 1))(tp, y, t_)
+
+        def skip_tail(args):
+            # Zeros in run_tail's exact output structure/dtypes (eval_shape:
+            # no compute traced) — cond branches must match precisely.
+            shapes = jax.eval_shape(run_tail, args)
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+        loss_k, (d_tail, d_y) = jax.lax.cond(
+            b_valid & is_last, run_tail, skip_tail, (tail_params, y_b, tgt))
         g_y = jnp.where(is_last, d_y, g_recv)
         d_stage, d_x = vjp(g_y)
         upd = b_valid
@@ -390,16 +339,21 @@ def interleave_chunk_layout(tree: PyTree, n_stages: int, n_chunks: int,
     chunks). Apply once at init (and ``inverse=True`` on returned grads if
     you want them back in virtual order) — NOT inside the step, where the
     cross-device gather would cost every tick."""
+    import numpy as _np
+    idx = _np.asarray(chunk_perm(n_stages, n_chunks, inverse=inverse))
+    return jax.tree_util.tree_map(lambda l: jnp.take(l, idx, axis=0), tree)
+
+
+def chunk_perm(n_stages: int, n_chunks: int, inverse: bool = False):
+    """THE device-major <-> virtual row permutation (one definition, shared
+    by :func:`interleave_chunk_layout` and the model-layer layout helpers):
+    ``perm[row]`` = source row. Forward: device-major row ``r*v + j`` reads
+    virtual row ``j*S + r``; inverse: virtual row ``j*S + r`` reads
+    device-major row ``r*v + j``."""
     v, s = n_chunks, n_stages
     if inverse:
-        # virtual row c = j*S + r reads device-major row r*v + j.
-        perm = [(row % s) * v + row // s for row in range(s * v)]
-    else:
-        # device-major row r*v + j reads virtual row j*S + r.
-        perm = [(row % v) * s + row // v for row in range(s * v)]
-    import numpy as _np
-    idx = _np.asarray(perm)
-    return jax.tree_util.tree_map(lambda l: jnp.take(l, idx, axis=0), tree)
+        return [(row % s) * v + row // s for row in range(s * v)]
+    return [(row % v) * s + row // v for row in range(s * v)]
 
 
 def interleaved_value_and_grad(stage_fn: Callable, tail_fn: Callable,

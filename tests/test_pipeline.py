@@ -339,6 +339,8 @@ def test_interleaved_deeper_and_chunks_one_degenerates():
         loss_o, gs_o, gt_o, gx_o = jax.jit(f_one)(w4, head4, x4, t4)
     np.testing.assert_allclose(float(loss_o), float(loss_p), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(gs_o), np.asarray(gs_p), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gt_o), np.asarray(gt_p), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx_o), np.asarray(gx_p), rtol=1e-5)
 
 
 def test_interleaved_wide_mesh_and_validation():
@@ -383,6 +385,37 @@ def test_interleaved_wide_mesh_and_validation():
                        "gain": jnp.ones(())}, head, x_mb, t_mb)
 
 
+def test_blocks_execution_order_roundtrip():
+    """Stored (device-major) <-> execution-order conversion round-trips, and
+    sequential_apply(interleaved cfg) equals the n_chunks=1 model applied to
+    the execution-order blocks — the checkpoint-migration contract."""
+    cfg = pipeline_lm.PipelineLMConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=4, d_ff=32, max_len=32,
+        n_stages=2, n_chunks=2, num_microbatches=2, dtype=jnp.float32)
+    model, params = pipeline_lm.init_params(cfg)
+    exe = pipeline_lm.blocks_to_execution_order(cfg, params["blocks"])
+    back = pipeline_lm.blocks_from_execution_order(cfg, exe)
+    for path, a in jax.tree_util.tree_leaves_with_path(params["blocks"]):
+        b = dict(jax.tree_util.tree_leaves_with_path(back))[path]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    import dataclasses
+    plain_model = pipeline_lm.PipelineLM(dataclasses.replace(cfg, n_chunks=1))
+    plain_params = dict(params, blocks=exe)
+    toks = jnp.asarray(pipeline_lm.synthetic_batch(cfg, 4, 8)["tokens"][:, :-1])
+    a = pipeline_lm.sequential_apply(model, params, toks)
+    b = pipeline_lm.sequential_apply(plain_model, plain_params, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # And the GPipe pipeline forward (model.apply) honors the stored layout:
+    # it must equal sequential_apply on the SAME interleaved config.
+    mesh = _pipe_mesh(cfg.n_stages)
+    with mesh:
+        c = jax.jit(lambda p, t: model.apply(p, t))(params, toks)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                               rtol=1e-4, atol=1e-5)
+
+
+
 def test_interleave_chunk_layout_roundtrip():
     from autodist_tpu.parallel.pipeline import interleave_chunk_layout
     x = jnp.arange(6 * 3).reshape(6, 3)           # V=6 rows
@@ -392,3 +425,42 @@ def test_interleave_chunk_layout_roundtrip():
     np.testing.assert_array_equal(np.asarray(fwd[:, 0]) // 3, expect)
     back = interleave_chunk_layout(fwd, n_stages=3, n_chunks=2, inverse=True)
     np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_pipeline_lm_interleaved_full_model_grads():
+    """The full-model INTERLEAVED step (n_chunks=2: 4 layers as 4 virtual
+    stages on 2 devices) returns the same loss and gradients as autodiff
+    over the sequential forward — same surface, thinner-tick schedule."""
+    cfg = pipeline_lm.PipelineLMConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=4, d_ff=32, max_len=32,
+        n_stages=2, n_chunks=2, num_microbatches=4, dtype=jnp.float32)
+    model, params = pipeline_lm.init_params(cfg)
+    batch = pipeline_lm.synthetic_batch(cfg, batch_size=8, seq_len=16)
+    mesh = _pipe_mesh(cfg.n_stages)
+
+    f_il = pipeline_lm.make_onef_oneb_value_and_grad(model)
+
+    def seq_loss(params, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = pipeline_lm.sequential_apply(model, params, inputs)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(
+            logprobs, targets[..., None], axis=-1)[..., 0].mean()
+
+    with mesh:
+        loss_i, grads_i = jax.jit(f_il)(params, batch)
+    loss_s, grads_s = jax.jit(jax.value_and_grad(seq_loss))(params, batch)
+    np.testing.assert_allclose(float(loss_i), float(loss_s), rtol=1e-5)
+    flat_s = jax.tree_util.tree_leaves_with_path(grads_s)
+    flat_i = dict(jax.tree_util.tree_leaves_with_path(grads_i))
+    for path, g in flat_s:
+        np.testing.assert_allclose(
+            np.asarray(flat_i[path]), np.asarray(g), rtol=2e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path))
+
+    import pytest
+    with pytest.raises(ValueError, match="num_microbatches"):
+        pipeline_lm.PipelineLMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, d_ff=32,
+            n_stages=2, n_chunks=2, num_microbatches=3, dtype=jnp.float32)
