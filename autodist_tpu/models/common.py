@@ -26,13 +26,17 @@ def num_groups(channels: int, max_groups: int) -> int:
     return g
 
 
-def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0):
+def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 0.0):
     """One sampling step over ``[B, vocab]`` logits -> ``[B]`` int32 tokens.
 
     ``temperature=0`` is greedy argmax (``key`` unused); otherwise logits are
-    scaled by ``1/temperature`` and, with ``top_k > 0``, truncated to the k
-    best before the categorical draw. f32 throughout — bf16 logit gaps near
-    the distribution tail would quantize away."""
+    scaled by ``1/temperature``, then optionally truncated to the ``top_k``
+    best and/or the nucleus of smallest-count tokens whose probability mass
+    reaches ``top_p`` (0 < p <= 1; the first token past the threshold is
+    kept, so the nucleus always covers >= p and is never empty). Both filters
+    compose (k first, then p over the survivors). f32 throughout — bf16
+    logit gaps near the distribution tail would quantize away."""
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -40,6 +44,18 @@ def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0):
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]   # descending
+        cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # Keep ranks whose PRECEDING mass is < p (shift by one): the token
+        # crossing the threshold stays in the nucleus.
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
+            axis=-1)
+        # Smallest kept logit per row = the nucleus cutoff.
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

@@ -184,6 +184,7 @@ def make_fused_full_softmax_loss_fn(model: LSTMLMWithHead) -> Callable:
 
 def generate(model: LSTMLMWithHead, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0,
              rng: Optional[jax.Array] = None) -> jax.Array:
     """Autoregressive generation: ``[B, P]`` int32 prompt ->
     ``[B, max_new_tokens]`` continuation, full-softmax head.
@@ -209,14 +210,14 @@ def generate(model: LSTMLMWithHead, params, prompt, max_new_tokens: int,
     h, variables = model.apply({"params": params}, prompt, decode=True,
                                mutable=["cache"])
     keys = jax.random.split(rng, max_new_tokens)
-    first = sample_logits(head(h[:, -1]), keys[0], temperature, top_k)
+    first = sample_logits(head(h[:, -1]), keys[0], temperature, top_k, top_p)
 
     def step(carry, key):
         cache, tok = carry
         h, variables = model.apply({"params": params, "cache": cache},
                                    tok[:, None], decode=True,
                                    mutable=["cache"])
-        nxt = sample_logits(head(h[:, 0]), key, temperature, top_k)
+        nxt = sample_logits(head(h[:, 0]), key, temperature, top_k, top_p)
         return (variables["cache"], nxt), nxt
 
     if max_new_tokens == 1:
@@ -226,13 +227,15 @@ def generate(model: LSTMLMWithHead, params, prompt, max_new_tokens: int,
 
 
 def make_generate_fn(model: LSTMLMWithHead, max_new_tokens: int,
-                     temperature: float = 0.0, top_k: int = 0) -> Callable:
+                     temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 0.0) -> Callable:
     """``jit``-compiled ``f(params, prompt, rng=None)`` closing over the
     statics (one compile per prompt shape) — mirrors
     :func:`autodist_tpu.models.transformer_lm.make_generate_fn`."""
     def f(params, prompt, rng=None):
         return generate(model, params, prompt, max_new_tokens,
-                        temperature=temperature, top_k=top_k, rng=rng)
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, rng=rng)
     return jax.jit(f)
 
 

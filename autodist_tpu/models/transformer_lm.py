@@ -263,6 +263,7 @@ from autodist_tpu.models.common import sample_logits  # noqa: E402,F401
 
 def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0,
              rng: Optional[jax.Array] = None) -> jax.Array:
     """Autoregressive generation with a KV cache: ``[B, P]`` int32 prompt ->
     ``[B, max_new_tokens]`` sampled continuation.
@@ -299,14 +300,14 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
                                     mutable=["cache"])
     last = lm_head_logits(hidden[:, -1], params, tied=cfg.tied_output)
     keys = jax.random.split(rng, max_new_tokens)
-    first = sample_logits(last, keys[0], temperature, top_k)
+    first = sample_logits(last, keys[0], temperature, top_k, top_p)
 
     def step(carry, key):
         cache, tok, pos = carry
         logits, variables = model.apply(
             {"params": params, "cache": cache}, tok[:, None], pos_offset=pos,
             decode=True, mutable=["cache"])
-        nxt = sample_logits(logits[:, 0], key, temperature, top_k)
+        nxt = sample_logits(logits[:, 0], key, temperature, top_k, top_p)
         return (variables["cache"], nxt, pos + 1), nxt
 
     if max_new_tokens == 1:
@@ -317,12 +318,14 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
 
 
 def make_generate_fn(model: TransformerLM, max_new_tokens: int,
-                     temperature: float = 0.0, top_k: int = 0) -> Callable:
+                     temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 0.0) -> Callable:
     """``jit``-compiled ``f(params, prompt, rng=None) -> [B, max_new_tokens]``
     closing over the statics (one compile per prompt shape)."""
     def f(params, prompt, rng=None):
         return generate(model, params, prompt, max_new_tokens,
-                        temperature=temperature, top_k=top_k, rng=rng)
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, rng=rng)
     return jax.jit(f)
 
 

@@ -105,6 +105,41 @@ def test_top_k_one_is_greedy_and_sampler_shapes():
     assert greedy.shape == (4,) and greedy.dtype == jnp.int32
 
 
+def test_top_p_nucleus_sampling():
+    """top_p keeps exactly the smallest head of the distribution reaching p
+    (the token crossing the threshold included), never an empty nucleus."""
+    # Row with known probabilities: softmax of these logits ~= [.6, .3, .1].
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.1]], jnp.float32))
+    keys = [jax.random.PRNGKey(i) for i in range(200)]
+    # p=0.5: nucleus = {0} (0.6 crosses the threshold) -> always token 0.
+    out = {int(sample_logits(logits, k, temperature=1.0, top_p=0.5)[0])
+           for k in keys}
+    assert out == {0}, out
+    # p=0.7: nucleus = {0, 1} (0.6 < p, +0.3 crosses) -> never token 2.
+    out = {int(sample_logits(logits, k, temperature=1.0, top_p=0.7)[0])
+           for k in keys}
+    assert out == {0, 1}, out
+    # A tiny p still keeps the argmax (nucleus never empty).
+    out = {int(sample_logits(logits, k, temperature=1.0, top_p=1e-6)[0])
+           for k in keys[:20]}
+    assert out == {0}, out
+    # Composes with top_k and threads through both generate APIs.
+    cfg = _small_cfg()
+    model, params = transformer_lm.init_params(cfg)
+    toks = generate(model, params, _tokens(cfg, 2, 4), 5,
+                    temperature=0.9, top_k=8, top_p=0.9,
+                    rng=jax.random.PRNGKey(3))
+    assert toks.shape == (2, 5) and int(toks.max()) < cfg.vocab_size
+    from autodist_tpu.models import lstm_lm
+    lcfg = lstm_lm.LSTMLMConfig(vocab_size=61, emb_dim=16, hidden_dim=24,
+                                n_layers=1, dtype=jnp.float32)
+    lmodel, lparams = lstm_lm.init_params(lcfg)
+    lt = lstm_lm.generate(lmodel, lparams, _tokens(lcfg, 2, 3), 4,
+                          temperature=0.9, top_p=0.8,
+                          rng=jax.random.PRNGKey(4))
+    assert lt.shape == (2, 4) and int(lt.max()) < lcfg.vocab_size
+
+
 def test_generate_single_token_and_remat_decode():
     """max_new_tokens=1 short-circuits the scan; a remat training config still
     decodes (remat is skipped on the decode path, which keeps no residuals)."""
