@@ -271,10 +271,13 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     TPU-shaped throughout: one full-prompt prefill apply writes the cache
     (position embeddings and causality handled by the decode path), then a
     single ``lax.scan`` of per-token steps — static shapes, no Python loop
-    over tokens, the cache donated through the carry. Works under ``jit``
-    (wrap with ``jax.jit(..., static_argnums=(0, 3, 4, 5))`` or close over
-    the statics); sharded/replicated params work as placed — XLA inserts any
-    collectives. The reference had no generation path at all (serving =
+    over tokens, the cache donated through the carry. Works under ``jit`` —
+    prefer :func:`make_generate_fn`, which closes over every static
+    (``model``, ``max_new_tokens``, ``temperature``, ``top_k``, ``top_p``)
+    correctly; hand-jitting needs ``static_argnums=(0, 3, 4, 5, 6)`` (all of
+    those, ``top_p`` included — a traced ``top_p`` fails the ``if top_p``
+    branch at trace time). Sharded/replicated params work as placed — XLA
+    inserts any collectives. The reference had no generation path at all (serving =
     SavedModel export); this is the TPU-native inference loop its exported
     models would still need.
     """

@@ -62,10 +62,15 @@ def test_decode_logits_match_full_forward(tied):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_greedy_generate_matches_naive_rollout():
+@pytest.mark.parametrize("tied", [True, False])
+def test_greedy_generate_matches_naive_rollout(tied):
     """generate(temperature=0) equals the no-cache rollout that reruns the
-    full forward over the growing sequence and argmaxes the last position."""
-    cfg = _small_cfg()
+    full forward over the growing sequence and argmaxes the last position.
+
+    Both head configs: the untied branch exercises generate()'s prefill
+    projection through ``params["lm_head"]["kernel"]`` (common.lm_head_logits),
+    which no other end-to-end test reaches."""
+    cfg = _small_cfg(tied_output=tied)
     model, params = transformer_lm.init_params(cfg)
     prompt = _tokens(cfg, batch=2, length=5, seed=3)
     n_new = 7
