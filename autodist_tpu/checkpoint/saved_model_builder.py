@@ -2,18 +2,25 @@
 
 Counterpart of reference ``checkpoint/saved_model_builder.py:24-64`` (a
 SavedModelBuilder that exported the transformed graph's variables under original
-names for vanilla-TF serving). The TPU-native serving artifact is a directory with:
+names for vanilla-TF serving; proven there by reloading the artifact and serving
+it in plain TF, ``tests/checkpoint/test_saved_model.py:26-40``). The TPU-native
+serving artifact is a directory with:
 
 - ``params.npz`` — full unsharded parameters under original names (via Saver),
 - ``model_config.json`` — user-provided model metadata (enough to rebuild the
   apply function),
-- optionally ``apply.hlo`` — the StableHLO text of the jitted apply function, a
-  framework-independent serving graph (what a SavedModel's GraphDef was to TF).
+- ``apply.export`` — the EXECUTABLE serving graph: a serialized ``jax.export``
+  artifact (versioned StableHLO bytes). :meth:`load_serving_fn` deserializes
+  and runs it with no model code imported — the TPU analogue of serving a
+  SavedModel's GraphDef in vanilla TF. Exported for both ``cpu`` and ``tpu``
+  so one artifact serves on a host or a chip.
+- ``apply.hlo`` — the same graph as StableHLO *text*, for human inspection and
+  non-JAX toolchains (kept alongside the executable form).
 """
 
 import json
 import os
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 
@@ -27,7 +34,22 @@ class SavedModelBuilder:
         os.makedirs(export_dir, exist_ok=True)
 
     def save(self, params: Any, model_config: Optional[dict] = None,
-             apply_fn: Optional[Callable] = None, example_args: tuple = ()) -> str:
+             apply_fn: Optional[Callable] = None, example_args: tuple = (),
+             platforms: Optional[Sequence[str]] = None,
+             polymorphic_batch: bool = False) -> str:
+        """Write the serving artifact.
+
+        ``apply_fn(params, *example_args)`` is traced once and exported as an
+        executable, framework-closed graph. ``platforms`` lowers the one
+        artifact for every listed backend; the default is the current backend
+        plus ``cpu``, so an artifact exported on a chip also serves on a host.
+        A function that only lowers on one backend (e.g. one calling pallas
+        TPU kernels) should pass ``platforms=("tpu",)`` explicitly.
+        ``polymorphic_batch=True`` exports with a symbolic leading dimension
+        on every array arg of rank >= 1 (scalars stay concrete), so the
+        served function accepts any batch size (otherwise the example shapes
+        are baked in, the fastest and most predictable form).
+        """
         saver = Saver(max_to_keep=1)
         saver.save(params, os.path.join(self._export_dir, "params"), global_step=0)
         # Rename to the stable serving name (no step suffix) and drop the Saver's
@@ -45,9 +67,36 @@ class SavedModelBuilder:
             json.dump(model_config or {}, f, indent=1, sort_keys=True)
 
         if apply_fn is not None:
-            lowered = jax.jit(apply_fn).lower(params, *example_args)
+            from jax import export as jax_export
+            if platforms is None:
+                current = jax.default_backend()
+                platforms = (current,) if current == "cpu" else (current, "cpu")
+            args = example_args
+            if polymorphic_batch:
+                (b,) = jax_export.symbolic_shape("b")
+
+                def _poly(a):
+                    arr = jax.numpy.asarray(a)
+                    if arr.ndim == 0:
+                        return a  # scalars have no batch dim; keep concrete
+                    return jax.ShapeDtypeStruct((b,) + arr.shape[1:], arr.dtype)
+
+                args = tuple(_poly(a) for a in example_args)
+            exported = jax_export.export(
+                jax.jit(apply_fn), platforms=tuple(platforms))(params, *args)
+            with open(os.path.join(self._export_dir, "apply.export"), "wb") as f:
+                f.write(exported.serialize())
+            # Inspectable text form of the same graph.
             with open(os.path.join(self._export_dir, "apply.hlo"), "w") as f:
-                f.write(lowered.as_text())
+                f.write(exported.mlir_module())
+        else:
+            # A re-save without apply_fn must not leave a previous export's
+            # graph behind: apply.export is EXECUTABLE, and serving a stale
+            # graph against new params is silent wrong output.
+            for name in ("apply.export", "apply.hlo"):
+                stale = os.path.join(self._export_dir, name)
+                if os.path.exists(stale):
+                    os.remove(stale)
 
         logging.info("Exported serving artifact to %s", self._export_dir)
         return self._export_dir
@@ -55,3 +104,18 @@ class SavedModelBuilder:
     @staticmethod
     def load_params(export_dir: str):
         return Saver().restore_params(os.path.join(export_dir, "params"))
+
+    @staticmethod
+    def load_serving_fn(export_dir: str) -> Callable:
+        """Deserialize ``apply.export`` into a callable ``fn(params, *args)``.
+
+        Pure artifact execution: nothing here imports or rebuilds model code —
+        the returned callable runs the serialized StableHLO through XLA, the
+        same contract as reference ``test_saved_model.py:26-40`` serving the
+        exported GraphDef in vanilla TF.
+        """
+        from jax import export as jax_export
+        path = os.path.join(export_dir, "apply.export")
+        with open(path, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        return exported.call
