@@ -1,8 +1,8 @@
 """Host data pipeline (native prefetch loader + device prefetch + datasets)."""
 
-from autodist_tpu.data import movielens, text_corpus
+from autodist_tpu.data import imagenet, mlm, movielens, text_corpus
 from autodist_tpu.data.loader import (DataLoader, device_prefetch,
                                       save_shards)
 
-__all__ = ["DataLoader", "device_prefetch", "save_shards", "movielens",
-           "text_corpus"]
+__all__ = ["DataLoader", "device_prefetch", "save_shards", "imagenet", "mlm",
+           "movielens", "text_corpus"]

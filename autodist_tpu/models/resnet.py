@@ -27,6 +27,43 @@ class ResNet50Config:
     width: int = 64
     dtype: Any = jnp.bfloat16
     norm_groups: int = 32
+    # "group" (default; pure function, no running stats) or "batch" —
+    # cross-replica sync-BN: statistics are computed in-graph over the batch
+    # axes, so under pjit with a batch-sharded input the mean/var reduce over
+    # the GLOBAL batch (GSPMD inserts the cross-replica collectives). Matches
+    # the reference Keras models' train-time normalization; running averages
+    # for eval are intentionally not tracked (the train step stays pure).
+    norm: str = "group"
+
+
+class SyncBatchNorm(nn.Module):
+    """Train-mode BatchNorm as a pure function: normalize by THIS batch's
+    statistics (no mutable running averages). Under a data-sharded ``pjit``
+    the reductions below span the global batch — this is sync-BN, the
+    distributed-framework capability the reference delegated to
+    ``CollectiveReduce`` in TF's BN layers."""
+    dtype: Any = jnp.bfloat16
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        # f32 statistics regardless of activation dtype (bf16 mean/var over a
+        # global batch loses too much precision).
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=(0, 1, 2))
+        var = ((xf - mean) ** 2).mean(axis=(0, 1, 2))
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return (y * scale + bias).astype(self.dtype)
+
+
+def _make_norm(cfg: ResNet50Config, channels: int, name: str):
+    if cfg.norm == "batch":
+        return SyncBatchNorm(dtype=cfg.dtype, name=name)
+    return nn.GroupNorm(num_groups=num_groups(channels, cfg.norm_groups),
+                        dtype=cfg.dtype, name=name)
 
 
 class BottleneckBlock(nn.Module):
@@ -37,8 +74,7 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        norm = lambda name: nn.GroupNorm(  # noqa: E731
-            num_groups=num_groups(self.filters, cfg.norm_groups), dtype=cfg.dtype, name=name)
+        norm = lambda name: _make_norm(cfg, self.filters, name)  # noqa: E731
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name="conv1")(x)
@@ -49,8 +85,7 @@ class BottleneckBlock(nn.Module):
         y = nn.relu(norm("norm2")(y))
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name="conv3")(y)
-        y = nn.GroupNorm(num_groups=num_groups(self.filters * 4, cfg.norm_groups),
-                         dtype=cfg.dtype, name="norm3")(y)
+        y = _make_norm(cfg, self.filters * 4, "norm3")(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters * 4, (1, 1),
                                strides=(self.strides, self.strides), use_bias=False,
@@ -68,8 +103,7 @@ class ResNet(nn.Module):
         x = images.astype(cfg.dtype)
         x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
                     dtype=cfg.dtype, param_dtype=jnp.float32, name="conv_init")(x)
-        x = nn.relu(nn.GroupNorm(num_groups=num_groups(cfg.width, cfg.norm_groups),
-                                 dtype=cfg.dtype, name="norm_init")(x))
+        x = nn.relu(_make_norm(cfg, cfg.width, "norm_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(cfg.stage_sizes):
             for block in range(n_blocks):

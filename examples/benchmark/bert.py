@@ -2,8 +2,13 @@
 
 Port of reference ``examples/benchmark/bert.py:41-47,194-215`` (BERT-large
 pretraining inside the AutoDist scope): masked-LM objective, AllReduce with bf16
-mixed precision, examples/sec instrumentation. Synthetic input with the
-fixed-prediction-slot layout the reference used (max_predictions_per_seq).
+mixed precision, examples/sec instrumentation, and a REAL pretrain data path —
+the reference consumed masked tfrecords via ``get_pretrain_dataset_fn``
+(``bert.py:82-98`` -> ``utils/input_pipeline.py``); here ``--tokenize_corpus``
+prepares raw token shards from a text corpus and ``--data_dir`` trains from
+them with dynamic per-batch masking (``autodist_tpu/data/mlm.py``). Without
+``--data_dir``, synthetic input with the same fixed-prediction-slot layout
+(max_predictions_per_seq).
 """
 
 import argparse
@@ -34,19 +39,65 @@ def main(argv=None):
     parser.add_argument("--steps", type=int, default=110)
     parser.add_argument("--batch_size", type=int, default=0)
     parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--max_predictions", type=int, default=20)
     parser.add_argument("--log_every", type=int, default=100)
     parser.add_argument("--resource_spec", type=str, default=None)
+    parser.add_argument("--data_dir", type=str, default=None,
+                        help="train from mlm token shards (prepared by "
+                             "--tokenize_corpus) with dynamic masking; "
+                             "default = synthetic batches")
+    parser.add_argument("--tokenize_corpus", type=str, default=None,
+                        help="text file/glob: prepare raw MLM shards into "
+                             "--data_dir and exit")
+    parser.add_argument("--vocab_size", type=int, default=30000,
+                        help="corpus-built vocab budget for --tokenize_corpus")
+    parser.add_argument("--segments", action="store_true",
+                        help="prep with [CLS] a [SEP] b [SEP] segment pairs")
     args = parser.parse_args(argv)
+
+    if args.tokenize_corpus:
+        if not args.data_dir:
+            parser.error("--tokenize_corpus needs --data_dir")
+        from autodist_tpu.data import mlm, text_corpus
+        vocab = text_corpus.build_vocab(args.tokenize_corpus,
+                                        max_size=args.vocab_size)
+        paths = mlm.prepare_mlm_shards(args.tokenize_corpus, vocab,
+                                       args.data_dir, seq_len=args.seq_len,
+                                       segments=args.segments)
+        print(f"prepared {len(paths['tokens'])} MLM shard(s) in "
+              f"{args.data_dir}; train with --data_dir {args.data_dir}")
+        return 0
 
     n_dev = len(jax.devices())
     batch_size = args.batch_size or 8 * n_dev
     on_accel = jax.default_backend() != "cpu"
+    size_kw = dict(SIZES[args.size])
+
+    feed = None
+    loader = None
+    if args.data_dir:
+        from autodist_tpu.data import mlm
+        try:
+            loader, meta = mlm.open_mlm_loader(args.data_dir,
+                                               batch_size=batch_size,
+                                               shuffle=True, prefetch=4)
+        except FileNotFoundError as e:
+            parser.error(str(e))
+        if meta["seq_len"] != args.seq_len:
+            parser.error(f"corpus was prepared at seq_len {meta['seq_len']}, "
+                         f"got --seq_len {args.seq_len}")
+        batcher = mlm.MLMBatcher(loader, vocab_size=meta["vocab_size"],
+                                 max_predictions=args.max_predictions)
+        size_kw["vocab_size"] = meta["vocab_size"]
+        batch = batcher.next()
     cfg = bert.BertConfig(max_len=args.seq_len,
                           dtype=jnp.bfloat16 if on_accel else jnp.float32,
-                          **SIZES[args.size])
+                          **size_kw)
 
     model = bert.Bert(cfg)
-    batch = bert.synthetic_batch(cfg, batch_size, args.seq_len)
+    if not args.data_dir:
+        batch = bert.synthetic_batch(cfg, batch_size, args.seq_len,
+                                     n_predictions=args.max_predictions)
     from autodist_tpu.models.common import jit_init
     params = jit_init(model, jnp.asarray(batch["tokens"]),
                       jnp.asarray(batch["token_types"]))
@@ -54,22 +105,40 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, AllReduce(compressor="HorovodCompressor"))
     step = ad.function(loss_fn, params, optax.adamw(1e-4), example_batch=batch)
-    # Keep the synthetic batch device-resident: re-shipping it from host
-    # every step benchmarks the host link, not the chip.
-    batch = step.runner.shard_batch(batch)
+    if args.data_dir:
+        # Masked batches stream from disk through the prefetch ring; the
+        # host->HBM transfer overlaps the running step (device_prefetch).
+        from autodist_tpu.data import device_prefetch
+        feed = device_prefetch(batcher, step.runner, depth=2)
+        next_batch = lambda: next(feed)  # noqa: E731
+    else:
+        # Keep the synthetic batch device-resident: re-shipping it from host
+        # every step benchmarks the host link, not the chip.
+        batch = step.runner.shard_batch(batch)
+        next_batch = lambda: batch  # noqa: E731
 
     meter = ThroughputMeter(batch_size=batch_size, log_every=args.log_every)
     loss = None
-    for _ in range(args.steps):
-        loss = step(batch)
-        meter.step(sync=loss)
-    print(f"bert-{args.size}: final loss {float(loss):.4f}, "
-          f"{meter.average or 0:.1f} examples/sec")
+    try:
+        for _ in range(args.steps):
+            loss = step(next_batch())
+            meter.step(sync=loss)
+        jax.device_get(loss)  # fence: trailing async steps must not inflate avg
+        # meter.average is a LIVE clock read — capture it before the MFU call
+        # below triggers its own lowering/compile work.
+        avg = meter.average or 0.0
+    finally:
+        if loader is not None:
+            loader.close()
+    src = "disk" if args.data_dir else "synthetic"
+    print(f"bert-{args.size} ({src}): final loss {float(loss):.4f}, "
+          f"{avg:.1f} examples/sec")
     from autodist_tpu.utils import flops as flops_util
     flops_util.report_mfu(
-        flops_util.train_step_flops(step.runner, step.get_state(), batch),
-        (meter.average or 0) / batch_size)
-    return meter.average
+        flops_util.train_step_flops(step.runner, step.get_state(),
+                                    step.runner.shard_batch(batch)),
+        avg / batch_size)
+    return avg
 
 
 if __name__ == "__main__":

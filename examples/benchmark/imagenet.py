@@ -4,8 +4,16 @@ Port of reference ``examples/benchmark/imagenet.py``: model selected by flag
 (ResNet-50 / VGG16 here vs the reference's Keras zoo, ``:150-170``), strategy
 selected by flag (``:161-170``), per-model AllReduce chunk sizes preserved as
 fusion-group hints (``:150-160``: vgg16=25, resnet=200, else 512), and
-TimeHistory-style examples/sec logging (``:84-133``). Synthetic data (the
-reference also supported synthetic ImageNet input).
+TimeHistory-style examples/sec logging (``:84-133``).
+
+Input: synthetic by default (the reference also supported synthetic ImageNet
+input), or REAL images — ``--prep_images`` decodes a ``<class>/<file>`` tree
+into uint8 record shards (the reference read tfrecords through
+``input_fn(data_dir=...)``, ``:219-229`` + ``utils/imagenet_preprocessing``);
+``--data_dir`` then streams them through the native loader with random
+crop/flip/mean-subtraction ON DEVICE inside the jitted step
+(``autodist_tpu/data/imagenet.py``). Disk-fed rates therefore INCLUDE input
+cost.
 """
 
 import argparse
@@ -56,45 +64,121 @@ def main(argv=None):
     parser.add_argument("--image_size", type=int, default=224)
     parser.add_argument("--log_every", type=int, default=100)
     parser.add_argument("--resource_spec", type=str, default=None)
+    parser.add_argument("--data_dir", type=str, default=None,
+                        help="train from image record shards (prepared by "
+                             "--prep_images); default = synthetic input")
+    parser.add_argument("--prep_images", type=str, default=None,
+                        help="<class>/<file> image tree: decode into uint8 "
+                             "record shards under --data_dir and exit")
+    parser.add_argument("--record_size", type=int, default=256,
+                        help="stored record side for --prep_images (crop "
+                             "source; must exceed --image_size)")
+    parser.add_argument("--pool_rows", type=int, default=0,
+                        help="cache mode: HBM record-pool rows (0 = auto, "
+                             "capped by DeviceDatasetCache's HBM budget)")
+    parser.add_argument("--norm", choices=["group", "batch"], default="group",
+                        help="resnet normalization: group (pure function) or "
+                             "batch (cross-replica sync-BN)")
+    parser.add_argument("--input_mode", choices=["cache", "stream"],
+                        default="cache",
+                        help="--data_dir feed: 'cache' = HBM-resident record "
+                             "pool with background refresh (the reference's "
+                             "training_dataset_cache, right for weak "
+                             "host->device links); 'stream' = full batches "
+                             "over the link per step (right on real TPU-VM "
+                             "PCIe)")
     args = parser.parse_args(argv)
+
+    if args.prep_images:
+        if not args.data_dir:
+            parser.error("--prep_images needs --data_dir")
+        from autodist_tpu.data import imagenet as imagenet_data
+        paths = imagenet_data.prepare_image_shards(
+            args.prep_images, args.data_dir, record_size=args.record_size)
+        print(f"prepared {len(paths['images'])} image shard(s) in "
+              f"{args.data_dir}; train with --data_dir {args.data_dir}")
+        return 0
 
     n_dev = len(jax.devices())
     batch_size = args.batch_size or 32 * n_dev
     on_accel = jax.default_backend() != "cpu"
     dtype = jnp.bfloat16 if on_accel else jnp.float32
+    if args.model == "inceptionv3":
+        args.image_size = max(args.image_size, 299)  # V3 stem needs >=299
+
+    num_classes = 1000
+    batcher = cache = loader = None
+    if args.data_dir:
+        from autodist_tpu.data import imagenet as imagenet_data
+        loader, meta = imagenet_data.open_image_loader(
+            args.data_dir, batch_size=batch_size, shuffle=True, prefetch=4)
+        if meta["record_size"] < args.image_size:
+            parser.error(f"records are {meta['record_size']}px, smaller than "
+                         f"--image_size {args.image_size}")
+        num_classes = len(meta["classes"])
+        if args.input_mode == "cache":
+            cache = imagenet_data.DeviceDatasetCache(
+                loader, record_size=meta["record_size"],
+                image_size=args.image_size, dtype=dtype,
+                pool_rows=args.pool_rows or None)
+        else:
+            batcher = imagenet_data.AugmentingBatcher(
+                loader, image_size=args.image_size,
+                record_size=meta["record_size"], train=True)
 
     if args.model in ("resnet50", "resnet101"):
         stages = (3, 4, 23, 3) if args.model == "resnet101" else (3, 4, 6, 3)
-        cfg = resnet.ResNet50Config(dtype=dtype, stage_sizes=stages)
+        cfg = resnet.ResNet50Config(dtype=dtype, stage_sizes=stages,
+                                    num_classes=num_classes, norm=args.norm)
         model, params = resnet.init_params(cfg, image_size=args.image_size)
         loss_fn = resnet.make_loss_fn(model)
-        batch = resnet.synthetic_batch(cfg, batch_size, args.image_size)
+        batch = None if args.data_dir else resnet.synthetic_batch(cfg, batch_size, args.image_size)
     elif args.model == "densenet121":
-        cfg = densenet.DenseNet121Config(dtype=dtype)
+        cfg = densenet.DenseNet121Config(dtype=dtype, num_classes=num_classes)
         model, params = densenet.init_params(cfg, image_size=args.image_size)
         loss_fn = densenet.make_loss_fn(model)
-        batch = densenet.synthetic_batch(cfg, batch_size, args.image_size)
+        batch = None if args.data_dir else densenet.synthetic_batch(cfg, batch_size, args.image_size)
     elif args.model == "inceptionv3":
-        image_size = max(args.image_size, 299)  # V3 stem needs >=299 input
-        cfg = inception.InceptionV3Config(dtype=dtype)
-        model, params = inception.init_params(cfg, image_size=image_size)
+        cfg = inception.InceptionV3Config(dtype=dtype, num_classes=num_classes)
+        model, params = inception.init_params(cfg, image_size=args.image_size)
         loss_fn = inception.make_loss_fn(model)
-        batch = inception.synthetic_batch(cfg, batch_size, image_size)
+        batch = None if args.data_dir else inception.synthetic_batch(cfg, batch_size, args.image_size)
     else:
-        model = vgg.VGG16(dtype=dtype)
+        model = vgg.VGG16(dtype=dtype, num_classes=num_classes)
         params = vgg.init_params(model, image_size=args.image_size)
         loss_fn = vgg.make_loss_fn(model)
-        batch = vgg.synthetic_batch(model.num_classes, batch_size, args.image_size)
+        batch = None if args.data_dir else vgg.synthetic_batch(model.num_classes, batch_size, args.image_size)
+
+    if batcher is not None:
+        # Stream mode: raw uint8 records + on-device crop/flip/normalize fused
+        # into the step (rates now include real input cost).
+        from autodist_tpu.data import imagenet as imagenet_data
+        loss_fn = imagenet_data.make_augmented_loss_fn(model, args.image_size,
+                                                       dtype)
+        batch = batcher.next()
+    elif cache is not None:
+        # Cache mode: the batch arrives pre-assembled on device (pool gather +
+        # augment in their own jit); the step keeps the plain loss.
+        batch = cache.next_batch(batch_size)
 
     ad = AutoDist(args.resource_spec, build_strategy(args.strategy, args.model))
     # lr 0.1+momentum diverges within ~50 steps on synthetic random labels (any
     # dtype); the benchmark wants steady-state throughput with finite loss.
     step = ad.function(loss_fn, params, optax.sgd(0.01, momentum=0.9),
                        example_batch=batch)
-    # Synthetic data lives on device for the whole run (the reference's synthetic
-    # ImageNet input was likewise graph-resident): re-shipping a multi-MB image
-    # batch from host every step would benchmark the host link, not the chip.
-    batch = step.runner.shard_batch(batch)
+    if cache is not None:
+        next_batch = lambda: cache.next_batch(batch_size)  # noqa: E731
+    elif batcher is not None:
+        from autodist_tpu.data import device_prefetch
+        feed = device_prefetch(batcher, step.runner, depth=2)
+        next_batch = lambda: next(feed)  # noqa: E731
+    else:
+        # Synthetic data lives on device for the whole run (the reference's
+        # synthetic ImageNet input was likewise graph-resident): re-shipping a
+        # multi-MB image batch from host every step would benchmark the host
+        # link, not the chip.
+        batch = step.runner.shard_batch(batch)
+        next_batch = lambda: batch  # noqa: E731
 
     from autodist_tpu.utils.benchmark_logger import (gather_run_info,
                                                      get_benchmark_logger)
@@ -107,7 +191,7 @@ def main(argv=None):
     # metric file handle instead of leaking it.
     try:
         for i in range(args.steps):
-            loss = step(batch)
+            loss = step(next_batch())
             rate = meter.step(sync=loss)
             if rate is not None:
                 bench_logger.log_metric("examples_per_second", rate,
@@ -119,12 +203,19 @@ def main(argv=None):
     except BaseException:
         bench_logger.on_finish(status="failure")
         raise
+    finally:
+        if loader is not None:
+            loader.close()
     bench_logger.on_finish()
-    print(f"{args.model}/{args.strategy}: final loss {float(loss):.4f}, "
+    src = "disk" if args.data_dir else "synthetic"
+    print(f"{args.model}/{args.strategy} ({src}): final loss {float(loss):.4f}, "
           f"{avg:.1f} examples/sec ({avg / max(n_dev, 1):.1f}/device)")
     from autodist_tpu.utils import flops as flops_util
+    # shard_batch so the cost-analysis lowering hits the training step's jit
+    # cache (a host-layout batch would trigger a second compile).
     flops_util.report_mfu(
-        flops_util.train_step_flops(step.runner, step.get_state(), batch),
+        flops_util.train_step_flops(step.runner, step.get_state(),
+                                    step.runner.shard_batch(batch)),
         avg / batch_size)
     return avg
 
