@@ -85,7 +85,7 @@ def main():
         cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, seq_len)
     mfu = flops_util.mfu(flops_per_token * tokens_per_sec / n_dev)
 
-    print(json.dumps({
+    result = {
         "metric": f"transformer_lm_train_tokens_per_sec ({platform} x{n_dev}, "
                   f"d{cfg.d_model}x{cfg.n_layers}, seq{seq_len}, bs{batch_size})",
         "value": round(tokens_per_sec, 1),
@@ -93,7 +93,31 @@ def main():
         "vs_baseline": round(per_device / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3),
         "flops_per_token": round(flops_per_token),
         "mfu": round(mfu, 4) if mfu is not None else None,
-    }))
+    }
+    # Regression gate vs the recorded best (PERF_BASELINE.json): annotate the
+    # JSON line and warn on stderr past the threshold. Round-over-round drift
+    # was previously invisible (428.6k -> 425.8k went unremarked); this line
+    # makes a real 2-3% regression impossible to miss. CPU runs measure a
+    # different machine entirely — the recorded bests are chip rates.
+    if on_accel:
+        import os
+        import sys
+        base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "PERF_BASELINE.json")
+        try:
+            with open(base_path) as f:
+                base = json.load(f)
+            best = base["rows"]["flagship"]["rate"]
+            threshold = base.get("threshold_pct", 2.0)
+            result["vs_best"] = round(tokens_per_sec / best, 4)
+            if tokens_per_sec < best * (1.0 - threshold / 100.0):
+                print(f"WARNING: flagship {tokens_per_sec:,.0f} tokens/s is "
+                      f"{100 * (1 - tokens_per_sec / best):.1f}% below the "
+                      f"recorded best {best:,.0f} (threshold {threshold}%) — "
+                      f"see PERF_BASELINE.json", file=sys.stderr)
+        except (OSError, KeyError, ValueError, TypeError):
+            pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

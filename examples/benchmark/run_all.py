@@ -108,6 +108,13 @@ def main(argv=None):
     parser.add_argument("--json", type=str, default="",
                         help="also write results to this JSON file")
     parser.add_argument("--list", action="store_true", help="list configs and exit")
+    parser.add_argument("--baseline", type=str,
+                        default=os.path.join(ROOT, "PERF_BASELINE.json"),
+                        help="recorded-best snapshot to diff against "
+                             "('' disables the comparison)")
+    parser.add_argument("--update_baseline", action="store_true",
+                        help="raise snapshot rows that this run beat "
+                             "(never lowers a row)")
     args = parser.parse_args(argv)
 
     if args.list:
@@ -125,15 +132,53 @@ def main(argv=None):
         print(f"running {name} ...", flush=True)
         results.append(run_config(name, str(args.steps)))
 
+    # Regression gate: diff each row against the recorded best. Steps below
+    # the sweep length are noisier, so the gate only annotates — failures
+    # stay human decisions; the >threshold rows are impossible to miss.
+    baseline = {}
+    snapshot = None
+    threshold = 2.0
+    if args.baseline and os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            snapshot = json.load(f)
+        baseline = snapshot.get("rows", {})
+        threshold = snapshot.get("threshold_pct", 2.0)
+
     width = max(len(r["name"]) for r in results)
+    regressions = []
     print()
     for r in results:
         if r["rate"] is None:
             print(f"{r['name']:<{width}}  FAILED: {r['error']}")
-        else:
-            mfu = (f"  mfu {r['mfu_pct']:.1f}%" if r.get("mfu_pct") is not None
-                   else "")
-            print(f"{r['name']:<{width}}  {r['rate']:>14,.1f} {r['unit']}{mfu}")
+            continue
+        mfu = (f"  mfu {r['mfu_pct']:.1f}%" if r.get("mfu_pct") is not None
+               else "")
+        delta = ""
+        best = baseline.get(r["name"], {}).get("rate")
+        if best:
+            pct = 100.0 * (r["rate"] / best - 1.0)
+            r["vs_best_pct"] = round(pct, 2)
+            delta = f"  {pct:+.1f}% vs best"
+            if pct < -threshold:
+                delta += "  << REGRESSION"
+                regressions.append((r["name"], pct))
+        print(f"{r['name']:<{width}}  {r['rate']:>14,.1f} {r['unit']}{mfu}{delta}")
+    if regressions:
+        print(f"\n{len(regressions)} row(s) regressed more than {threshold}% "
+              f"vs {args.baseline}: "
+              + ", ".join(f"{n} ({p:+.1f}%)" for n, p in regressions))
+    if args.update_baseline and snapshot is not None:
+        raised = []
+        for r in results:
+            row = snapshot.setdefault("rows", {}).get(r["name"])
+            if r["rate"] is not None and row and r["rate"] > row["rate"]:
+                row["rate"] = round(r["rate"], 1)
+                row["recorded"] = "run_all --update_baseline"
+                raised.append(r["name"])
+        if raised:
+            with open(args.baseline, "w") as f:
+                json.dump(snapshot, f, indent=1)
+            print(f"baseline raised for: {', '.join(raised)}")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1)

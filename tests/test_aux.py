@@ -135,6 +135,33 @@ def test_bert_benchmark_tiny():
     assert avg is None or avg >= 0
 
 
+def test_run_all_regression_gate(tmp_path, monkeypatch, capsys):
+    """run_all diffs rows against the recorded-best snapshot: >threshold drops
+    are flagged, --update_baseline raises (never lowers) beaten rows."""
+    import json as _json
+
+    import examples.benchmark.run_all as run_all
+
+    base = tmp_path / "base.json"
+    base.write_text(_json.dumps({"threshold_pct": 2.0, "rows": {
+        "resnet50": {"rate": 1000.0, "unit": "examples/s"},
+        "vgg16": {"rate": 1000.0, "unit": "examples/s"}}}))
+    canned = {"resnet50": 900.0, "vgg16": 1100.0}
+    monkeypatch.setattr(run_all, "run_config", lambda name, steps: {
+        "name": name, "unit": "examples/s", "rate": canned[name],
+        "mfu_pct": None, "error": None})
+
+    results = run_all.main(["--only", "resnet50,vgg16",
+                            "--baseline", str(base), "--update_baseline"])
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "resnet50" in out
+    assert results[0]["vs_best_pct"] == -10.0
+    assert results[1]["vs_best_pct"] == 10.0
+    snap = _json.loads(base.read_text())
+    assert snap["rows"]["vgg16"]["rate"] == 1100.0   # raised
+    assert snap["rows"]["resnet50"]["rate"] == 1000.0  # never lowered
+
+
 def test_throughput_meter_zero_warmup():
     meter = ThroughputMeter(batch_size=4, log_every=2, warmup_steps=0)
     for _ in range(4):
