@@ -109,10 +109,12 @@ def main():
                 base = json.load(f)
             best = base["rows"]["flagship"]["rate"]
             threshold = base.get("threshold_pct", 2.0)
-            result["vs_best"] = round(tokens_per_sec / best, 4)
-            if tokens_per_sec < best * (1.0 - threshold / 100.0):
-                print(f"WARNING: flagship {tokens_per_sec:,.0f} tokens/s is "
-                      f"{100 * (1 - tokens_per_sec / best):.1f}% below the "
+            # The snapshot records PER-CHIP rates; compare per-device so a
+            # multi-chip aggregate can't mask a per-chip regression.
+            result["vs_best"] = round(per_device / best, 4)
+            if per_device < best * (1.0 - threshold / 100.0):
+                print(f"WARNING: flagship {per_device:,.0f} tokens/s/chip is "
+                      f"{100 * (1 - per_device / best):.1f}% below the "
                       f"recorded best {best:,.0f} (threshold {threshold}%) — "
                       f"see PERF_BASELINE.json", file=sys.stderr)
         except (OSError, KeyError, ValueError, TypeError):

@@ -6,6 +6,10 @@
 # Usage:
 #   ./ci.sh            # lint + full suite + multi-chip dryrun + bench smoke
 #   ./ci.sh --fast     # lint + suite only (skip dryrun + bench)
+#   ./ci.sh --dist     # ONLY the distributed ssh-stage rehearsal (the
+#                      # docker/compose.dist.yml sequence as local processes:
+#                      # Cluster's real ssh branch through docker/ssh_shim,
+#                      # strategy scp + worker relaunch + jax.distributed join)
 #
 # Environment notes (baked in below so a fresh clone needs nothing):
 # - The test suite and dryrun run on an 8-device virtual CPU mesh
@@ -23,6 +27,13 @@ REPO_ROOT="$(pwd)"
 export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:$PYTHONPATH}"
 FAST=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
+
+if [[ "${1:-}" == "--dist" ]]; then
+    echo "=== distributed stage rehearsal (compose.dist.yml sequence, ssh shim) ==="
+    JAX_PLATFORMS=cpu python -m pytest tests/test_ssh_stage.py -q
+    echo "=== dist stage OK ==="
+    exit 0
+fi
 
 echo "=== [1/4] lint ==="
 # Prefer a real linter when the environment has one; otherwise fall back to a

@@ -1,0 +1,91 @@
+"""Framework-free baseline: raw jax.jit(value_and_grad) + optax, no AutoDist.
+
+The "no-framework program" side of each benchmark row's two-sided ceiling
+proof (docs/usage/performance.md): if this rate matches the framework step's,
+the distance to peak belongs to XLA/the model shape, not the strategy
+machinery. Mirrors the imagenet benchmark's configs (same models, dtype,
+optimizer, synthetic input, device-resident batch).
+
+    python examples/benchmark/raw_jax_baseline.py --model densenet121 --batch_size 128
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="densenet121",
+                        choices=["resnet50", "vgg16", "densenet121",
+                                 "inceptionv3"])
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--image_size", type=int, default=224)
+    parser.add_argument("--steps", type=int, default=60)
+    args = parser.parse_args(argv)
+
+    from autodist_tpu.models import densenet, inception, resnet, vgg
+
+    on_accel = jax.default_backend() != "cpu"
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    if args.model == "inceptionv3":
+        args.image_size = max(args.image_size, 299)
+
+    if args.model == "resnet50":
+        cfg = resnet.ResNet50Config(dtype=dtype)
+        model, params = resnet.init_params(cfg, image_size=args.image_size)
+        loss_fn = resnet.make_loss_fn(model)
+        batch = resnet.synthetic_batch(cfg, args.batch_size, args.image_size)
+    elif args.model == "densenet121":
+        cfg = densenet.DenseNet121Config(dtype=dtype)
+        model, params = densenet.init_params(cfg, image_size=args.image_size)
+        loss_fn = densenet.make_loss_fn(model)
+        batch = densenet.synthetic_batch(cfg, args.batch_size, args.image_size)
+    elif args.model == "inceptionv3":
+        cfg = inception.InceptionV3Config(dtype=dtype)
+        model, params = inception.init_params(cfg, image_size=args.image_size)
+        loss_fn = inception.make_loss_fn(model)
+        batch = inception.synthetic_batch(cfg, args.batch_size, args.image_size)
+    else:
+        model = vgg.VGG16(dtype=dtype)
+        params = vgg.init_params(model, image_size=args.image_size)
+        loss_fn = vgg.make_loss_fn(model)
+        batch = vgg.synthetic_batch(model.num_classes, args.batch_size,
+                                    args.image_size)
+
+    tx = optax.sgd(0.01, momentum=0.9)  # the imagenet benchmark's optimizer
+    opt_state = tx.init(params)
+    batch = {k: jax.device_put(jnp.asarray(v)) for k, v in batch.items()}
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.device_get(loss)
+    dt = time.perf_counter() - t0
+    rate = args.batch_size * args.steps / dt
+    print(f"raw-jax {args.model} bs{args.batch_size}: {rate:,.1f} examples/sec")
+
+    from autodist_tpu.utils import flops as flops_util
+    per_step = flops_util.jit_flops(step, params, opt_state, batch)
+    flops_util.report_mfu(per_step, rate / args.batch_size)
+    return rate
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,24 @@ import sys
 
 ROOT = os.path.join(os.path.dirname(__file__), "..", "..")
 
+# --update_baseline refuses runs shorter than the sweep length: sub-sweep
+# rates are noisy, and the baseline only ratchets up.
+MIN_BASELINE_STEPS = 60
+
+
+def _probe_devices():
+    """(device_count, backend) of the platform the benchmark subprocesses will
+    see — probed in a subprocess so run_all itself never initializes a chip."""
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(len(jax.devices()), jax.default_backend())"],
+        capture_output=True, text=True)
+    try:
+        count, backend = probe.stdout.strip().split()[-2:]
+        return int(count), backend
+    except (ValueError, IndexError):
+        return 1, "unknown"
+
 # name -> (argv builder, unit, number regex over combined output)
 RATE = r"([\d,]+\.?\d*)"
 CONFIGS = {
@@ -135,10 +153,16 @@ def main(argv=None):
     # Regression gate: diff each row against the recorded best. Steps below
     # the sweep length are noisier, so the gate only annotates — failures
     # stay human decisions; the >threshold rows are impossible to miss.
+    # The snapshot records PER-CHIP ACCELERATOR rates: normalize by device
+    # count, and skip the comparison entirely on CPU (a different machine).
     baseline = {}
     snapshot = None
     threshold = 2.0
-    if args.baseline and os.path.exists(args.baseline):
+    n_dev, backend = _probe_devices()
+    if backend == "cpu":
+        print("\n(CPU backend: PERF_BASELINE comparison skipped — recorded "
+              "bests are chip rates)")
+    elif args.baseline and os.path.exists(args.baseline):
         with open(args.baseline) as f:
             snapshot = json.load(f)
         baseline = snapshot.get("rows", {})
@@ -156,7 +180,8 @@ def main(argv=None):
         delta = ""
         best = baseline.get(r["name"], {}).get("rate")
         if best:
-            pct = 100.0 * (r["rate"] / best - 1.0)
+            per_chip = r["rate"] / max(n_dev, 1)
+            pct = 100.0 * (per_chip / best - 1.0)
             r["vs_best_pct"] = round(pct, 2)
             delta = f"  {pct:+.1f}% vs best"
             if pct < -threshold:
@@ -168,12 +193,19 @@ def main(argv=None):
               f"vs {args.baseline}: "
               + ", ".join(f"{n} ({p:+.1f}%)" for n, p in regressions))
     if args.update_baseline and snapshot is not None:
+        if args.steps < MIN_BASELINE_STEPS:
+            parser.error(f"--update_baseline needs --steps >= "
+                         f"{MIN_BASELINE_STEPS}: short runs are noisy, and a "
+                         f"ratcheted outlier makes every honest later run "
+                         f"read as a regression")
         raised = []
         for r in results:
             row = snapshot.setdefault("rows", {}).get(r["name"])
-            if r["rate"] is not None and row and r["rate"] > row["rate"]:
-                row["rate"] = round(r["rate"], 1)
-                row["recorded"] = "run_all --update_baseline"
+            per_chip = (r["rate"] / max(n_dev, 1)
+                        if r["rate"] is not None else None)
+            if per_chip is not None and row and per_chip > row["rate"]:
+                row["rate"] = round(per_chip, 1)
+                row["recorded"] = "run_all --update_baseline (per-chip)"
                 raised.append(r["name"])
         if raised:
             with open(args.baseline, "w") as f:

@@ -150,6 +150,9 @@ def test_run_all_regression_gate(tmp_path, monkeypatch, capsys):
     monkeypatch.setattr(run_all, "run_config", lambda name, steps: {
         "name": name, "unit": "examples/s", "rate": canned[name],
         "mfu_pct": None, "error": None})
+    # The gate is per-chip and accelerator-only; fake a 1-chip TPU so the
+    # comparison runs on the CPU test host.
+    monkeypatch.setattr(run_all, "_probe_devices", lambda: (1, "tpu"))
 
     results = run_all.main(["--only", "resnet50,vgg16",
                             "--baseline", str(base), "--update_baseline"])

@@ -1,14 +1,17 @@
-"""Strategy x model-case integration matrix.
+"""Strategy x model-case x mesh-shape integration matrix.
 
-The reference's integration tier ran the cartesian product {resource specs} x
-{10 strategies} x {9 model cases} (``tests/integration/test_all.py:49-70``), with
+The reference's integration tier ran the cartesian product {2 resource specs} x
+{10 strategies} x {9 model cases} (``tests/integration/test_all.py:20-70``), with
 cases covering placeholders, CNNs, sparse embeddings, ``while_loop`` models, and
 dynamic RNNs. Same product here on the 8-device CPU-sim mesh: every strategy
 family must train every case shape — dense MLP, conv net, sparse embedding,
-``lax.scan`` recurrence (the while_loop analog), LSTM-style gated recurrence —
-with a decreasing loss and finite parameters. No forked processes needed: each
-combo builds a fresh AutoDist (the reference needed a process per combo because
-its runtime was one-instance-per-process, ``test_all.py:49-70``).
+PARTITIONED sparse embedding (uneven rows), ``lax.scan`` recurrence (the
+while_loop analog), LSTM-style gated recurrence — on BOTH mesh shapes (pure
+data-parallel, and a TP-capable ``{model: 2}`` mesh), each combo value-exact
+against the single-process jit loss at step 0 and descending thereafter. No
+forked processes needed: each combo builds a fresh AutoDist (the reference
+needed a process per combo because its runtime was one-instance-per-process,
+``test_all.py:49-70``).
 """
 
 import jax
@@ -135,10 +138,30 @@ def _case_lstm():
     return params, batch, loss
 
 
+def _case_partitioned_embedding():
+    """LARGE sparse embedding with a prime row count (reference c2 at the
+    partitioner's scale): partitioning strategies must split the table —
+    unevenly, 1031 doesn't divide — while the gradient stays sparse."""
+    rng = np.random.RandomState(5)
+    params = {
+        "emb": jnp.asarray(rng.randn(1031, 16) * 0.1, jnp.float32),
+        "w": jnp.asarray(rng.randn(16, 1) * 0.1, jnp.float32),
+    }
+    batch = {"idx": rng.randint(0, 1031, size=(BATCH, 6)),
+             "y": rng.randn(BATCH, 1).astype(np.float32)}
+
+    def loss(p, b):
+        e = jnp.take(p["emb"], b["idx"], axis=0).mean(axis=1)
+        return jnp.mean((e @ p["w"] - b["y"]) ** 2)
+
+    return params, batch, loss
+
+
 CASES = {
     "mlp": _case_mlp,
     "cnn": _case_cnn,
     "embedding": _case_embedding,
+    "part_embedding": _case_partitioned_embedding,
     "scan_rnn": _case_scan_rnn,
     "lstm": _case_lstm,
 }
@@ -148,14 +171,30 @@ STRATEGIES = [
     AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax, AutoStrategy,
 ]
 
+# Two mesh shapes, the reference's {2 resource specs} dimension: the default
+# pure-data mesh, and a TP-capable mesh with a non-trivial model axis.
+MESHES = {
+    "data8": None,
+    "model2": "{nodes: [{address: localhost, tpus: 8}], mesh: {model: 2}}",
+}
 
+
+@pytest.mark.parametrize("mesh_name", list(MESHES), ids=str)
 @pytest.mark.parametrize("case_name", list(CASES), ids=str)
 @pytest.mark.parametrize("builder_cls", STRATEGIES, ids=lambda c: c.__name__)
-def test_strategy_times_case(builder_cls, case_name):
+def test_strategy_times_case(builder_cls, case_name, mesh_name):
     params, batch, loss = CASES[case_name]()
-    ad = AutoDist(strategy_builder=builder_cls())
+    # Value-exactness anchor: whatever the strategy/mesh does, step 0's loss
+    # must equal the plain single-process jit loss on the same params/batch
+    # (the reference's c0 criterion).
+    expected0 = float(jax.jit(loss)(params, {k: jnp.asarray(v)
+                                             for k, v in batch.items()}))
+    ad = AutoDist(MESHES[mesh_name], strategy_builder=builder_cls())
     step = ad.function(loss, params, optax.adam(3e-2), example_batch=batch)
     losses = [float(step(batch)) for _ in range(8)]
+    np.testing.assert_allclose(losses[0], expected0, rtol=1e-5, atol=1e-6,
+                               err_msg=f"{builder_cls.__name__}/{case_name}/"
+                                       f"{mesh_name}")
     assert np.all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], (builder_cls.__name__, case_name, losses)
     final = step.get_state().params
