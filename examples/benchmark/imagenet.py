@@ -24,6 +24,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from autodist_tpu import AutoDist
@@ -76,6 +77,13 @@ def main(argv=None):
     parser.add_argument("--pool_rows", type=int, default=0,
                         help="cache mode: HBM record-pool rows (0 = auto, "
                              "capped by DeviceDatasetCache's HBM budget)")
+    parser.add_argument("--eval", action="store_true",
+                        help="one pass over --data_dir with the eval "
+                             "preprocessing (center crop, no flip): top-1/"
+                             "top-5 accuracy (reference is_training=False)")
+    parser.add_argument("--restore", type=str, default=None,
+                        help="checkpoint prefix to evaluate (Saver format); "
+                             "default = fresh init (chance accuracy)")
     parser.add_argument("--norm", choices=["group", "batch"], default="group",
                         help="resnet normalization: group (pure function) or "
                              "batch (cross-replica sync-BN)")
@@ -108,23 +116,28 @@ def main(argv=None):
 
     num_classes = 1000
     batcher = cache = loader = None
+    if args.eval and not args.data_dir:
+        parser.error("--eval needs --data_dir")
     if args.data_dir:
         from autodist_tpu.data import imagenet as imagenet_data
+        # Eval = one DETERMINISTIC pass: sequential read, center crop, no flip
+        # (the reference's is_training=False input).
         loader, meta = imagenet_data.open_image_loader(
-            args.data_dir, batch_size=batch_size, shuffle=True, prefetch=4)
+            args.data_dir, batch_size=batch_size, shuffle=not args.eval,
+            prefetch=4)
         if meta["record_size"] < args.image_size:
             parser.error(f"records are {meta['record_size']}px, smaller than "
                          f"--image_size {args.image_size}")
         num_classes = len(meta["classes"])
-        if args.input_mode == "cache":
+        if args.eval or args.input_mode == "stream":
+            batcher = imagenet_data.AugmentingBatcher(
+                loader, image_size=args.image_size,
+                record_size=meta["record_size"], train=not args.eval)
+        else:
             cache = imagenet_data.DeviceDatasetCache(
                 loader, record_size=meta["record_size"],
                 image_size=args.image_size, dtype=dtype,
                 pool_rows=args.pool_rows or None)
-        else:
-            batcher = imagenet_data.AugmentingBatcher(
-                loader, image_size=args.image_size,
-                record_size=meta["record_size"], train=True)
 
     if args.model in ("resnet50", "resnet101"):
         stages = (3, 4, 23, 3) if args.model == "resnet101" else (3, 4, 6, 3)
@@ -160,6 +173,45 @@ def main(argv=None):
         # Cache mode: the batch arrives pre-assembled on device (pool gather +
         # augment in their own jit); the step keeps the plain loss.
         batch = cache.next_batch(batch_size)
+
+    if args.eval:
+        if args.restore:
+            from autodist_tpu.checkpoint import Saver
+            params = Saver().restore_params(args.restore)
+        from autodist_tpu.data import imagenet as imagenet_data
+
+        def metric_fn(p, b):
+            x = imagenet_data.augment_images(b["images"], b["crop_yx"],
+                                             b["flip"], args.image_size, dtype)
+            logits = model.apply({"params": p}, x).astype(jnp.float32)
+            top5 = jax.lax.top_k(logits, min(5, logits.shape[-1]))[1]
+            c1 = (jnp.argmax(logits, -1) == b["labels"]).sum()
+            c5 = (top5 == b["labels"][:, None]).any(-1).sum()
+            return jnp.stack([c1, c5])
+
+        ad = AutoDist(args.resource_spec,
+                      build_strategy(args.strategy, args.model))
+        step = ad.function(loss_fn, params, optax.sgd(0.0),
+                           example_batch=batch)
+        state = step.get_state()
+        n_batches = loader.n_rows // batch_size
+        counts = np.zeros(2)
+        for i in range(n_batches):
+            # The example batch already consumed the loader's first rows
+            # (sequential in eval) — score it rather than skipping them.
+            b = batch if i == 0 else batcher.next()
+            counts += np.asarray(step.runner.evaluate(state, b, fn=metric_fn))
+        loader.close()
+        seen = n_batches * batch_size
+        skipped = loader.n_rows - seen
+        if skipped:
+            print(f"WARNING: {skipped} tail example(s) skipped (static batch "
+                  f"shapes drop the remainder); pick a --batch_size dividing "
+                  f"{loader.n_rows} for exact coverage")
+        top1, top5 = counts / max(seen, 1)
+        print(f"{args.model} eval ({seen}/{loader.n_rows} examples, center "
+              f"crop {args.image_size}): top-1 {top1:.4f}  top-5 {top5:.4f}")
+        return float(top1)
 
     ad = AutoDist(args.resource_spec, build_strategy(args.strategy, args.model))
     # lr 0.1+momentum diverges within ~50 steps on synthetic random labels (any

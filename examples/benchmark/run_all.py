@@ -140,6 +140,13 @@ def main(argv=None):
             print(name)
         return []
 
+    if args.update_baseline and args.steps < MIN_BASELINE_STEPS:
+        # Reject the combination BEFORE the (potentially hour-long) run, not
+        # after it: short runs are noisy, and the baseline only ratchets up.
+        parser.error(f"--update_baseline needs --steps >= {MIN_BASELINE_STEPS}"
+                     f": a ratcheted noise outlier makes every honest later "
+                     f"run read as a regression")
+
     names = [n.strip() for n in args.only.split(",") if n.strip()] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
@@ -193,11 +200,6 @@ def main(argv=None):
               f"vs {args.baseline}: "
               + ", ".join(f"{n} ({p:+.1f}%)" for n, p in regressions))
     if args.update_baseline and snapshot is not None:
-        if args.steps < MIN_BASELINE_STEPS:
-            parser.error(f"--update_baseline needs --steps >= "
-                         f"{MIN_BASELINE_STEPS}: short runs are noisy, and a "
-                         f"ratcheted outlier makes every honest later run "
-                         f"read as a regression")
         raised = []
         for r in results:
             row = snapshot.setdefault("rows", {}).get(r["name"])
