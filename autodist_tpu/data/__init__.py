@@ -2,7 +2,8 @@
 
 from autodist_tpu.data import imagenet, mlm, movielens, text_corpus
 from autodist_tpu.data.loader import (DataLoader, device_prefetch,
-                                      save_shards)
+                                      save_shards, shard_files_for_process)
 
-__all__ = ["DataLoader", "device_prefetch", "save_shards", "imagenet", "mlm",
-           "movielens", "text_corpus"]
+__all__ = ["DataLoader", "device_prefetch", "save_shards",
+           "shard_files_for_process", "imagenet", "mlm", "movielens",
+           "text_corpus"]

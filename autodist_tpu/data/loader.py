@@ -106,6 +106,35 @@ def save_shards(arrays: Dict[str, np.ndarray], directory: str,
     return out
 
 
+def shard_files_for_process(files: Dict[str, FileSpec], process_id: int,
+                            num_processes: int) -> Dict[str, List[str]]:
+    """Multi-host input sharding at FILE granularity: process ``i`` reads
+    shards ``i::n`` of every key — the reference's ``dataset.shard(
+    num_input_pipelines, input_pipeline_id)`` applied to its file list
+    (``examples/benchmark/imagenet.py:219-229``,
+    ``utils/input_pipeline.py``). Keys stay row-aligned because all keys drop
+    the same shard indices. Each process then builds its own ``DataLoader``
+    over its subset and feeds its local devices — no process ever reads
+    another's bytes.
+
+    Requires at least as many shards as processes (a process with zero shards
+    is a bug in the prep step's ``rows_per_shard``, not a valid
+    configuration)."""
+    if not 0 <= process_id < num_processes:
+        raise ValueError(f"process_id {process_id} out of [0, {num_processes})")
+    out: Dict[str, List[str]] = {}
+    for key, spec in files.items():
+        paths = [spec] if isinstance(spec, (str, os.PathLike)) else list(spec)
+        mine = [os.fspath(p) for p in paths[process_id::num_processes]]
+        if not mine:
+            raise ValueError(
+                f"files[{key!r}]: {len(paths)} shard(s) cannot feed "
+                f"{num_processes} processes; re-prep with smaller "
+                f"rows_per_shard")
+        out[key] = mine
+    return out
+
+
 def _open_segments(files: Dict[str, FileSpec]) -> Dict[str, List[np.ndarray]]:
     """mmap every shard; validate row alignment across keys and dtype/shape
     consistency across a key's shards."""

@@ -53,6 +53,12 @@ def main(argv=None):
                         help="corpus-built vocab budget for --tokenize_corpus")
     parser.add_argument("--segments", action="store_true",
                         help="prep with [CLS] a [SEP] b [SEP] segment pairs")
+    parser.add_argument("--eval", action="store_true",
+                        help="one deterministic pass over --data_dir: "
+                             "masked-LM accuracy (the reference's "
+                             "masked_lm_accuracy metric)")
+    parser.add_argument("--restore", type=str, default=None,
+                        help="checkpoint prefix to evaluate (Saver format)")
     args = parser.parse_args(argv)
 
     if args.tokenize_corpus:
@@ -73,14 +79,18 @@ def main(argv=None):
     on_accel = jax.default_backend() != "cpu"
     size_kw = dict(SIZES[args.size])
 
+    if args.eval and not args.data_dir:
+        parser.error("--eval needs --data_dir")
     feed = None
     loader = None
     if args.data_dir:
         from autodist_tpu.data import mlm
         try:
+            # Eval = one deterministic pass: sequential read, seeded masking.
             loader, meta = mlm.open_mlm_loader(args.data_dir,
                                                batch_size=batch_size,
-                                               shuffle=True, prefetch=4)
+                                               shuffle=not args.eval,
+                                               prefetch=4)
         except FileNotFoundError as e:
             parser.error(str(e))
         if meta["seq_len"] != args.seq_len:
@@ -98,12 +108,53 @@ def main(argv=None):
     if not args.data_dir:
         batch = bert.synthetic_batch(cfg, batch_size, args.seq_len,
                                      n_predictions=args.max_predictions)
-    from autodist_tpu.models.common import jit_init
-    params = jit_init(model, jnp.asarray(batch["tokens"]),
-                      jnp.asarray(batch["token_types"]))
+    if args.eval and args.restore:
+        # The restore below replaces params wholesale; skip the (expensive on
+        # bert-large) fresh initialization.
+        params = None
+    else:
+        from autodist_tpu.models.common import jit_init
+        params = jit_init(model, jnp.asarray(batch["tokens"]),
+                          jnp.asarray(batch["token_types"]))
     loss_fn = bert.make_mlm_loss_fn(model)
 
     ad = AutoDist(args.resource_spec, AllReduce(compressor="HorovodCompressor"))
+
+    if args.eval:
+        import numpy as np
+
+        if args.restore:
+            from autodist_tpu.checkpoint import Saver
+            params = Saver().restore_params(args.restore)
+
+        def metric_fn(p, b):
+            logits = model.apply({"params": p}, b["tokens"],
+                                 b["token_types"],
+                                 mlm_positions=b["mlm_positions"])
+            pred = jnp.argmax(logits.astype(jnp.float32), -1)
+            w = b["mlm_weights"]
+            return jnp.stack([((pred == b["mlm_targets"]) * w).sum(), w.sum()])
+
+        step = ad.function(loss_fn, params, optax.sgd(0.0),
+                           example_batch=batch)
+        state = step.get_state()
+        n_batches = loader.n_rows // batch_size
+        counts = np.zeros(2)
+        for i in range(n_batches):
+            b = batch if i == 0 else batcher.next()  # first rows already drawn
+            counts += np.asarray(step.runner.evaluate(state, b, fn=metric_fn))
+        loader.close()
+        skipped = loader.n_rows - n_batches * batch_size
+        if skipped:
+            print(f"WARNING: {skipped} tail row(s) skipped (static batch "
+                  f"shapes drop the remainder); pick a --batch_size dividing "
+                  f"{loader.n_rows} for exact coverage")
+        acc = counts[0] / max(counts[1], 1)
+        print(f"bert-{args.size} eval ({int(counts[1])} masked positions over "
+              f"{n_batches * batch_size}/{loader.n_rows} rows): "
+              f"masked_lm_accuracy {acc:.4f}")
+        return float(acc)
+
     step = ad.function(loss_fn, params, optax.adamw(1e-4), example_batch=batch)
     if args.data_dir:
         # Masked batches stream from disk through the prefetch ring; the

@@ -139,26 +139,36 @@ def main(argv=None):
                 image_size=args.image_size, dtype=dtype,
                 pool_rows=args.pool_rows or None)
 
+    # --eval --restore overwrites params wholesale: skip the (expensive on
+    # large models) fresh initialization in that case.
+    need_init = not (args.eval and args.restore)
     if args.model in ("resnet50", "resnet101"):
         stages = (3, 4, 23, 3) if args.model == "resnet101" else (3, 4, 6, 3)
         cfg = resnet.ResNet50Config(dtype=dtype, stage_sizes=stages,
                                     num_classes=num_classes, norm=args.norm)
-        model, params = resnet.init_params(cfg, image_size=args.image_size)
+        model = resnet.ResNet(cfg)
+        params = resnet.init_params(cfg, image_size=args.image_size)[1] \
+            if need_init else None
         loss_fn = resnet.make_loss_fn(model)
         batch = None if args.data_dir else resnet.synthetic_batch(cfg, batch_size, args.image_size)
     elif args.model == "densenet121":
         cfg = densenet.DenseNet121Config(dtype=dtype, num_classes=num_classes)
-        model, params = densenet.init_params(cfg, image_size=args.image_size)
+        model = densenet.DenseNet(cfg)
+        params = densenet.init_params(cfg, image_size=args.image_size)[1] \
+            if need_init else None
         loss_fn = densenet.make_loss_fn(model)
         batch = None if args.data_dir else densenet.synthetic_batch(cfg, batch_size, args.image_size)
     elif args.model == "inceptionv3":
         cfg = inception.InceptionV3Config(dtype=dtype, num_classes=num_classes)
-        model, params = inception.init_params(cfg, image_size=args.image_size)
+        model = inception.InceptionV3(cfg)
+        params = inception.init_params(cfg, image_size=args.image_size)[1] \
+            if need_init else None
         loss_fn = inception.make_loss_fn(model)
         batch = None if args.data_dir else inception.synthetic_batch(cfg, batch_size, args.image_size)
     else:
         model = vgg.VGG16(dtype=dtype, num_classes=num_classes)
-        params = vgg.init_params(model, image_size=args.image_size)
+        params = vgg.init_params(model, image_size=args.image_size) \
+            if need_init else None
         loss_fn = vgg.make_loss_fn(model)
         batch = None if args.data_dir else vgg.synthetic_batch(model.num_classes, batch_size, args.image_size)
 
@@ -173,6 +183,8 @@ def main(argv=None):
         # Cache mode: the batch arrives pre-assembled on device (pool gather +
         # augment in their own jit); the step keeps the plain loss.
         batch = cache.next_batch(batch_size)
+
+    ad = AutoDist(args.resource_spec, build_strategy(args.strategy, args.model))
 
     if args.eval:
         if args.restore:
@@ -189,8 +201,6 @@ def main(argv=None):
             c5 = (top5 == b["labels"][:, None]).any(-1).sum()
             return jnp.stack([c1, c5])
 
-        ad = AutoDist(args.resource_spec,
-                      build_strategy(args.strategy, args.model))
         step = ad.function(loss_fn, params, optax.sgd(0.0),
                            example_batch=batch)
         state = step.get_state()
@@ -213,7 +223,6 @@ def main(argv=None):
               f"crop {args.image_size}): top-1 {top1:.4f}  top-5 {top5:.4f}")
         return float(top1)
 
-    ad = AutoDist(args.resource_spec, build_strategy(args.strategy, args.model))
     # lr 0.1+momentum diverges within ~50 steps on synthetic random labels (any
     # dtype); the benchmark wants steady-state throughput with finite loss.
     step = ad.function(loss_fn, params, optax.sgd(0.01, momentum=0.9),

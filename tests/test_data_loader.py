@@ -195,3 +195,34 @@ def test_device_prefetch_feeds_training():
     losses = [float(step(next(feed))) for _ in range(20)]
     assert losses[-1] < 0.1 * losses[0]
     dl.close()
+
+
+def test_shard_files_for_process(tmp_path):
+    """File-granularity multi-host input sharding (the reference's
+    dataset.shard over its file list): processes get disjoint shard subsets
+    that stay row-aligned across keys and cover every row exactly once."""
+    import pytest
+
+    from autodist_tpu.data import save_shards, shard_files_for_process
+
+    rng = np.random.RandomState(0)
+    arrays = {"a": rng.randn(50, 3).astype(np.float32),
+              "b": np.arange(50, dtype=np.int32)}
+    files = save_shards(arrays, str(tmp_path), rows_per_shard=8)  # 7 shards
+
+    seen = []
+    for pid in range(3):
+        mine = shard_files_for_process(files, pid, 3)
+        # Same shard indices for every key: row alignment survives.
+        assert [p.split("-")[-1] for p in mine["a"]] == \
+               [p.split("-")[-1] for p in mine["b"]]
+        dl = DataLoader(files=mine, batch_size=1, shuffle=False, native=False)
+        for _ in range(dl.n_rows):
+            seen.append(int(dl.next()["b"][0]))
+        dl.close()
+    assert sorted(seen) == list(range(50))  # disjoint and complete
+
+    with pytest.raises(ValueError, match="cannot feed"):
+        shard_files_for_process(files, 7, 8)
+    with pytest.raises(ValueError, match="out of"):
+        shard_files_for_process(files, 3, 3)
