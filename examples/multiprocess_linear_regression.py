@@ -128,14 +128,27 @@ def run_two_process_chief(out_path: str, workdir: str, timeout: int = 300,
         s.bind(("127.0.0.1", 0))
         env["AUTODIST_COORDINATOR_PORT"] = str(s.getsockname()[1])
         s.close()
-        proc = subprocess.run(
-            [sys.executable, script or os.path.abspath(__file__), str(out_path),
-             *extra_args],
-            env=env, cwd=repo_root, capture_output=True, text=True, timeout=timeout)
-        port_lost = proc.returncode != 0 and (
+        try:
+            proc = subprocess.run(
+                [sys.executable, script or os.path.abspath(__file__),
+                 str(out_path), *extra_args],
+                env=env, cwd=repo_root, capture_output=True, text=True,
+                timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            # A missed gloo/coordination handshake (DEADLINE_EXCEEDED under
+            # heavy host load, e.g. sharded CI) leaves both processes waiting
+            # forever; a fresh attempt on a fresh port recovers.
+            if attempt == attempts - 1:
+                raise
+            print(f"run_two_process_chief: attempt {attempt + 1} timed out "
+                  f"({'DEADLINE_EXCEEDED' if e.stderr and b'DEADLINE_EXCEEDED' in e.stderr else 'no handshake error visible'}); retrying",
+                  flush=True)
+            continue
+        retryable = proc.returncode != 0 and (
             "address already in use" in proc.stderr.lower()
-            or "failed to bind" in proc.stderr.lower())
-        if not port_lost or attempt == attempts - 1:
+            or "failed to bind" in proc.stderr.lower()
+            or "deadline_exceeded" in proc.stderr.lower())
+        if not retryable or attempt == attempts - 1:
             return proc
     return proc
 

@@ -17,6 +17,10 @@ os.environ.setdefault("AUTODIST_IS_TESTING", "1")
 import jax  # noqa: E402  (sitecustomize may have imported jax already — env alone is too late)
 
 jax.config.update("jax_platforms", "cpu")
+# Pin the backend NOW: initialization is otherwise lazy, and a test module
+# that adjusts XLA_FLAGS for its own subprocesses (imported before the first
+# device touch) would silently re-shape every later test's "8-device" mesh.
+assert len(jax.devices()) == 8, jax.devices()
 
 import pytest  # noqa: E402
 

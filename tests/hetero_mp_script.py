@@ -13,10 +13,14 @@ import os
 import sys
 
 # Per-role local device count BEFORE the backend initializes: chief 2, worker 1.
-_worker = bool(os.environ.get("AUTODIST_WORKER"))
-os.environ["XLA_FLAGS"] = (
-    f"--xla_force_host_platform_device_count={1 if _worker else 2}")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# ONLY when running as the script — mutating XLA_FLAGS on a mere import would
+# poison the importing pytest process's own (lazy) backend init, flipping its
+# 8-device mesh to 2 for every later test in that process.
+if __name__ == "__main__":
+    _worker = bool(os.environ.get("AUTODIST_WORKER"))
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={1 if _worker else 2}")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
