@@ -242,7 +242,12 @@ class DeviceDatasetCache:
             # through a bounded pool, not OOM at startup.
             row_bytes = record_size * record_size * 3
             pool_rows = max(1, self.DEFAULT_POOL_BYTES // row_bytes)
-        self._rows = min(pool_rows, loader.n_rows)
+        # The loader serves whole batches (drop-last): a pool larger than the
+        # servable row count would fill its tail from the NEXT epoch's batches
+        # — duplicate rows in the pool, and (sequential loaders) the dropped
+        # tail never cached. Size to whole batches instead.
+        servable = loader.n_rows - loader.n_rows % loader.batch_size
+        self._rows = min(pool_rows, servable)
         self._buf_imgs: Optional[np.ndarray] = None  # undrained loader rows
         self._buf_labs: Optional[np.ndarray] = None
         self._refresh_rows = min(refresh_rows, self._rows) if refresh_rows else 0
@@ -286,9 +291,11 @@ class DeviceDatasetCache:
         The device_put below is async: it has ``refresh_interval`` steps of
         compute to cross the link before _update consumes it."""
         import jax
-        if self._refresh_rows == 0 or self._loader.n_rows <= self._rows:
-            if self._loader.n_rows <= self._rows and self._refresh_rows:
-                # Dataset fits in the pool: it IS the dataset; nothing to
+        servable = self._loader.n_rows - \
+            self._loader.n_rows % self._loader.batch_size
+        if self._refresh_rows == 0 or servable <= self._rows:
+            if servable <= self._rows and self._refresh_rows:
+                # Every row the loader can serve is resident: nothing to
                 # stream (the reference cache's fully-cached steady state).
                 self._refresh_rows = 0
             return

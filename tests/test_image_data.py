@@ -143,6 +143,28 @@ def test_device_dataset_cache_assembles_and_refreshes(tmp_path):
     loader.close()
 
 
+def test_device_dataset_cache_no_duplicates_on_non_divisible_dataset(tmp_path):
+    """48 rows at loader batch 10: only 40 are servable (drop-last), so the
+    pool sizes to 40 whole-batch rows and the fill never wraps an epoch —
+    a wrapped fill would plant duplicate rows (and, sequential, permanently
+    omit the tail)."""
+    tree = str(tmp_path / "tree")
+    _write_tree(tree, n_classes=3, per_class=16)  # 48 rows
+    out = str(tmp_path / "shards")
+    imagenet.prepare_image_shards(tree, out, record_size=32, rows_per_shard=64)
+    loader, _ = imagenet.open_image_loader(out, batch_size=10, shuffle=False,
+                                           native=False)
+    cache = imagenet.DeviceDatasetCache(loader, record_size=32, image_size=32,
+                                        seed=0)
+    assert cache.pool_rows == 40
+    pool = np.asarray(cache._pool)
+    # Sequential fill of 4 exact batches: rows are the first 40 records, each
+    # exactly once.
+    flat = pool.reshape(40, -1)
+    assert len(np.unique(flat, axis=0)) == 40
+    loader.close()
+
+
 def test_device_dataset_cache_fully_cached_dataset(tmp_path):
     """A pool covering the whole dataset stops streaming (the reference
     training_dataset_cache's steady state) and keeps labels consistent."""
