@@ -106,25 +106,37 @@ def test_cross_process_bounded_staleness_ps(tmp_path):
     for k in ROLE_ENV_VARS:
         env.pop(k, None)
 
-    proc = subprocess.run([sys.executable, script, str(out)], env=env,
-                          cwd=os.path.dirname(os.path.dirname(script)),
-                          capture_output=True, text=True, timeout=300)
-    assert proc.returncode == 0, (
-        f"chief failed (rc={proc.returncode})\n"
-        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
-    result = json.loads(out.read_text())
+    # The unblocked-steps-are-fast signature is wall-clock-based: a transient
+    # host load spike (sharded CI saturating the core) can push an unblocked
+    # step past the bound with the gate semantics perfectly healthy. The
+    # CORRECTNESS assertions stay hard every attempt; only a failed timing
+    # signature retries on a fresh run.
+    for attempt in range(3):
+        proc = subprocess.run([sys.executable, script, str(out)], env=env,
+                              cwd=os.path.dirname(os.path.dirname(script)),
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, (
+            f"chief failed (rc={proc.returncode})\n"
+            f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+        result = json.loads(out.read_text())
 
-    assert result["fast_steps"] == aps.FAST_STEPS
-    assert result["slow_steps"] == aps.SLOW_STEPS
-    # Every gradient from both processes was applied by the shared service.
-    assert result["final_version"] == aps.FAST_STEPS + aps.SLOW_STEPS
+        assert result["fast_steps"] == aps.FAST_STEPS
+        assert result["slow_steps"] == aps.SLOW_STEPS
+        # Every gradient from both processes was applied by the shared service.
+        assert result["final_version"] == aps.FAST_STEPS + aps.SLOW_STEPS
 
-    durations = result["durations"]
-    # First `staleness` steps run unblocked (fast); each following step must wait
-    # for the slow worker's ~SLOW_SLEEP cadence at the gate.
-    fast, gated = durations[:aps.STALENESS], durations[aps.STALENESS:]
-    assert all(d < aps.SLOW_SLEEP * 0.6 for d in fast), durations
-    assert all(d > aps.SLOW_SLEEP * 0.3 for d in gated), durations
+        durations = result["durations"]
+        # First `staleness` steps run unblocked (fast); each following step
+        # must wait for the slow worker's ~SLOW_SLEEP cadence at the gate.
+        fast, gated = durations[:aps.STALENESS], durations[aps.STALENESS:]
+        timing_ok = (all(d < aps.SLOW_SLEEP * 0.6 for d in fast)
+                     and all(d > aps.SLOW_SLEEP * 0.3 for d in gated))
+        if timing_ok:
+            break
+        print(f"staleness timing signature failed under load "
+              f"(attempt {attempt + 1}): {durations}; retrying")
+    else:
+        raise AssertionError(f"timing signature failed 3 attempts: {durations}")
 
 
 def _run_matrix_config(tmp_path, config):
