@@ -143,6 +143,34 @@ def test_device_dataset_cache_assembles_and_refreshes(tmp_path):
     loader.close()
 
 
+def test_native_loader_serves_image_rows(tmp_path):
+    """The C++ gather ring on image-shaped rows (4-D uint8, ~3 KB each): one
+    shuffled epoch serves every record exactly once with labels still
+    row-aligned to their images — the wide-tensor case the data plane's
+    generic tests (2-D float) don't shape-check. (Native and fallback RNGs
+    differ by design, so the check is coverage, not order.)"""
+    tree = str(tmp_path / "tree")
+    _write_tree(tree, n_classes=2, per_class=10)  # 20 rows
+    out = str(tmp_path / "shards")
+    imagenet.prepare_image_shards(tree, out, record_size=32, rows_per_shard=8)
+    loader, _ = imagenet.open_image_loader(out, batch_size=5, shuffle=True,
+                                           seed=3, native=None)
+    if not loader.is_native:
+        loader.close()
+        pytest.skip("no C++ toolchain in this environment")
+    rows = []
+    for _ in range(4):  # 20 rows / batch 5 = one full epoch
+        b = loader.next()
+        assert b["images"].shape == (5, 32, 32, 3)
+        for img, lab in zip(b["images"], b["labels"]):
+            # Row alignment survives the native gather: class c is bright in
+            # channel c (the prep-tree invariant).
+            assert img.astype(np.float32).mean(axis=(0, 1)).argmax() == lab
+            rows.append(img.tobytes())
+    assert len(set(rows)) == 20  # every record exactly once per epoch
+    loader.close()
+
+
 def test_device_dataset_cache_no_duplicates_on_non_divisible_dataset(tmp_path):
     """48 rows at loader batch 10: only 40 are servable (drop-last), so the
     pool sizes to 40 whole-batch rows and the fill never wraps an epoch —
