@@ -40,6 +40,9 @@ def main(argv=None):
     parser.add_argument("--batch_size", type=int, default=0)
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--max_predictions", type=int, default=20)
+    parser.add_argument("--accum", type=int, default=1,
+                        help="gradient-accumulation micro-batches per step "
+                             "(global batch = --batch_size; must divide it)")
     parser.add_argument("--log_every", type=int, default=100)
     parser.add_argument("--resource_spec", type=str, default=None)
     parser.add_argument("--data_dir", type=str, default=None,
@@ -155,7 +158,8 @@ def main(argv=None):
               f"masked_lm_accuracy {acc:.4f}")
         return float(acc)
 
-    step = ad.function(loss_fn, params, optax.adamw(1e-4), example_batch=batch)
+    step = ad.function(loss_fn, params, optax.adamw(1e-4), example_batch=batch,
+                       accumulation_steps=args.accum)
     if args.data_dir:
         # Masked batches stream from disk through the prefetch ring; the
         # host->HBM transfer overlaps the running step (device_prefetch).
@@ -185,10 +189,14 @@ def main(argv=None):
     print(f"bert-{args.size} ({src}): final loss {float(loss):.4f}, "
           f"{avg:.1f} examples/sec")
     from autodist_tpu.utils import flops as flops_util
-    flops_util.report_mfu(
-        flops_util.train_step_flops(step.runner, step.get_state(),
-                                    step.runner.shard_batch(batch)),
-        avg / batch_size)
+    per_step = flops_util.train_step_flops(step.runner, step.get_state(),
+                                           step.runner.shard_batch(batch))
+    if per_step and args.accum > 1:
+        # XLA's cost analysis counts a lax.scan body ONCE, not per trip: the
+        # accumulation scan runs accum micro-batches per step. Scaling the
+        # whole count slightly over-weights the (tiny) optimizer apply.
+        per_step *= args.accum
+    flops_util.report_mfu(per_step, avg / batch_size)
     return avg
 
 

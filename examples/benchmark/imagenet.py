@@ -63,6 +63,9 @@ def main(argv=None):
     parser.add_argument("--batch_size", type=int, default=0,
                         help="global batch; 0 = 32 per device")
     parser.add_argument("--image_size", type=int, default=224)
+    parser.add_argument("--accum", type=int, default=1,
+                        help="gradient-accumulation micro-batches per step "
+                             "(global batch = --batch_size; must divide it)")
     parser.add_argument("--log_every", type=int, default=100)
     parser.add_argument("--resource_spec", type=str, default=None)
     parser.add_argument("--data_dir", type=str, default=None,
@@ -226,7 +229,7 @@ def main(argv=None):
     # lr 0.1+momentum diverges within ~50 steps on synthetic random labels (any
     # dtype); the benchmark wants steady-state throughput with finite loss.
     step = ad.function(loss_fn, params, optax.sgd(0.01, momentum=0.9),
-                       example_batch=batch)
+                       example_batch=batch, accumulation_steps=args.accum)
     if cache is not None:
         next_batch = lambda: cache.next_batch(batch_size)  # noqa: E731
     elif batcher is not None:
@@ -274,10 +277,12 @@ def main(argv=None):
     from autodist_tpu.utils import flops as flops_util
     # shard_batch so the cost-analysis lowering hits the training step's jit
     # cache (a host-layout batch would trigger a second compile).
-    flops_util.report_mfu(
-        flops_util.train_step_flops(step.runner, step.get_state(),
-                                    step.runner.shard_batch(batch)),
-        avg / batch_size)
+    per_step = flops_util.train_step_flops(step.runner, step.get_state(),
+                                           step.runner.shard_batch(batch))
+    if per_step and args.accum > 1:
+        # XLA's cost analysis counts a lax.scan body once, not per trip.
+        per_step *= args.accum
+    flops_util.report_mfu(per_step, avg / batch_size)
     return avg
 
 

@@ -61,8 +61,8 @@ CONFIGS = {
                                "--steps", s, "--log_every", s],
                     "examples/s", RATE + r" examples/sec"),
     "bert_base": (lambda s: [os.path.join(ROOT, "examples/benchmark/bert.py"),
-                             "--size", "base", "--batch_size", "256",
-                             "--steps", s, "--log_every", s],
+                             "--size", "base", "--batch_size", "2048",
+                             "--accum", "8", "--steps", s, "--log_every", s],
                   "examples/s", RATE + r" examples/sec"),
     "bert_large": (lambda s: [os.path.join(ROOT, "examples/benchmark/bert.py"),
                               "--size", "large", "--batch_size", "128",
@@ -75,7 +75,8 @@ CONFIGS = {
                        "--steps", s, "--log_every", s],
             "examples/s", RATE + r" examples/sec"),
     "moe": (lambda s: [os.path.join(ROOT, "examples/moe_lm.py"),
-                       "--batch_size", "128", "--steps", s, "--log_every", s],
+                       "--batch_size", "512", "--accum", "4",
+                       "--steps", s, "--log_every", s],
             "tokens/s", RATE + r" tokens/sec"),
 }
 

@@ -31,6 +31,9 @@ def main(argv=None):
     parser.add_argument("--expert_axis", type=int, default=-1,
                         help="-1 = auto (largest divisor of devices and experts)")
     parser.add_argument("--vocab", type=int, default=32000)
+    parser.add_argument("--accum", type=int, default=1,
+                        help="gradient-accumulation micro-batches per step "
+                             "(global batch = --batch_size; must divide it)")
     parser.add_argument("--log_every", type=int, default=50)
     parser.add_argument("--resource_spec", type=str, default=None)
     args = parser.parse_args(argv)
@@ -54,7 +57,8 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, strategy_builder=ExpertParallel(
         num_experts=args.n_experts, expert_axis_size=args.expert_axis))
-    step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch)
+    step = ad.function(loss_fn, params, optax.adam(1e-3), example_batch=batch,
+                       accumulation_steps=args.accum)
 
     meter = ThroughputMeter(batch_size=args.batch_size * args.seq_len,
                             log_every=args.log_every, unit="tokens")
