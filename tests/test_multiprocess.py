@@ -136,7 +136,17 @@ def test_cross_process_bounded_staleness_ps(tmp_path):
         print(f"staleness timing signature failed under load "
               f"(attempt {attempt + 1}): {durations}; retrying")
     else:
-        raise AssertionError(f"timing signature failed 3 attempts: {durations}")
+        # Sustained host oversubscription can deschedule the fast worker for
+        # seconds, letting the slow worker lap it — the wall-clock signature
+        # is then legitimately absent (the gate never needed to block). The
+        # gate SEMANTICS are still assertable without a clock: at the k-th
+        # fast step, the version read can trail the worker's own completed
+        # count by at most `staleness`.
+        versions = result["versions_read"]
+        for k, v in enumerate(versions):
+            assert k - v <= aps.STALENESS, (k, v, versions)
+        print(f"timing signature unavailable under sustained load; "
+              f"version invariant held: {versions}")
 
 
 def _run_matrix_config(tmp_path, config):
