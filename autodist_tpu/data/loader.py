@@ -310,17 +310,28 @@ class DataLoader:
             pass
 
 
-def device_prefetch(loader: DataLoader, runner, depth: int = 2):
+def device_prefetch(loader: DataLoader, runner, depth: int = 2,
+                    unroll: int = 1):
     """Iterator of on-device sharded batches, ``depth`` transfers ahead.
 
     ``runner.shard_batch`` is the feed remapping (split over data axes /
     replicate); issuing it ahead of consumption overlaps host->HBM transfer with
     the running step — the TPU analogue of the reference's staged input queues.
+
+    With ``unroll=K`` (K > 1) each yielded item is instead a pre-sharded
+    :class:`~autodist_tpu.runner.BatchBlock` stacking K consecutive loader
+    batches (``runner.shard_block``) for the fused multi-step path
+    (``runner.run_many``); ``depth`` then counts blocks, so the queue keeps
+    ``depth * K`` steps of data in flight.
     """
     import collections
     pending = collections.deque()
     it = iter(loader)
     while True:
         while len(pending) < max(1, depth):
-            pending.append(runner.shard_batch(next(it)))
+            if unroll > 1:
+                pending.append(
+                    runner.shard_block([next(it) for _ in range(unroll)]))
+            else:
+                pending.append(runner.shard_batch(next(it)))
         yield pending.popleft()

@@ -324,6 +324,12 @@ class AsyncPSRunner(DistributedRunner):
     # pool (workers that never step) from a silent hang into a diagnosable error.
     DEFAULT_STEP_TIMEOUT = 600.0
 
+    # No fused multi-step scan here: every step round-trips through the
+    # parameter service (pull -> grad -> apply under the staleness gate), so
+    # there is no K-step on-device program to build. run_many raises (see
+    # DistributedRunner.run_many) and train(unroll=K) falls back to per-step.
+    supports_run_many = False
+
     def __init__(self, compiled_strategy, model_spec, loss_fn, optimizer,
                  mesh=None, has_aux: bool = False, num_workers: int = 1,
                  donate_state: bool = False, plan=None,

@@ -72,6 +72,26 @@ def _is_micro(leaf) -> bool:
     return isinstance(leaf, MicroBatched)
 
 
+class BatchBlock:
+    """K pre-sharded batches stacked along a leading step axis.
+
+    Built by :meth:`DistributedRunner.shard_block` (or
+    ``data.loader.device_prefetch(..., unroll=K)``) and consumed by
+    :meth:`DistributedRunner.run_many`, which scans the step body over the
+    leading axis — one compiled dispatch for K optimizer steps. A host-side
+    handle, not a pytree: ``tree`` is the on-device stacked batch pytree and
+    ``length`` the number of steps it carries."""
+
+    __slots__ = ("tree", "length")
+
+    def __init__(self, tree, length: int):
+        self.tree = tree
+        self.length = length
+
+    def __len__(self) -> int:
+        return self.length
+
+
 @dataclasses.dataclass
 class TrainState:
     """One training step's carried state (a pytree)."""
@@ -98,6 +118,11 @@ class DistributedRunner:
     Counterpart of reference ``WrappedSession`` (``runner.py:78-132``): constructed
     from the *compiled* strategy, owns the mesh, shards state, steps batches.
     """
+
+    # Whether run_many's fused multi-step scan is available. The async/remote
+    # regimes override to False: their parameter service applies gradients
+    # host-step by host-step, so there is no on-device K-step program to fuse.
+    supports_run_many = True
 
     def __init__(self, compiled_strategy, model_spec: ModelSpec, loss_fn: Callable,
                  optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
@@ -131,6 +156,7 @@ class DistributedRunner:
         # Compiled steps keyed by fetch fn (None = plain step); reference cached
         # one built runner per graph the same way (autodist.py:280-287).
         self._step_fns: dict = {}
+        self._many_fns: dict = {}   # fused K-step scans, same keying
         self._eval_fns: dict = {}
         self._state_shardings = None
 
@@ -176,7 +202,11 @@ class DistributedRunner:
             return place(state)
 
     # -------------------------------------------------------------------- step
-    def _build_step(self, fetch_fn: Optional[Callable] = None):
+    def _make_step_body(self, fetch_fn: Optional[Callable] = None):
+        """The pure (untraced) one-step function ``(state, batch) -> (state,
+        (loss, aux, fetched))``. Single source of the step math: ``_build_step``
+        jits it directly and ``_build_many`` scans it — so the fused multi-step
+        path can never drift numerically from the per-step path."""
         import jax.numpy as jnp
 
         optimizer = self._optimizer
@@ -249,23 +279,50 @@ class DistributedRunner:
                 fetched = ()
             return new_state, (loss, aux, fetched)
 
+        return step_fn
+
+    def _cap_fn_cache(self, cache: dict, where: str):
+        if len(cache) > 8:
+            # Fetch callables are cache keys by identity: per-call lambdas would
+            # recompile the full step every run and pin executables forever.
+            evict = next(k for k in cache if k is not None)
+            del cache[evict]
+            logging.warning(
+                "More than 8 distinct fetch callables compiled; pass a stable "
+                "function to %s instead of per-call lambdas "
+                "(each new identity recompiles the whole training step)", where)
+
+    def _build_step(self, fetch_fn: Optional[Callable] = None):
         donate = (0,) if self._donate else ()
         jitted = jax.jit(
-            step_fn,
+            self._make_step_body(fetch_fn),
             in_shardings=(self._state_shardings, None),
             out_shardings=(self._state_shardings, None),
             donate_argnums=donate,
         )
         self._step_fns[fetch_fn] = jitted
-        if len(self._step_fns) > 8:
-            # Fetch callables are cache keys by identity: per-call lambdas would
-            # recompile the full step every run and pin executables forever.
-            evict = next(k for k in self._step_fns if k is not None)
-            del self._step_fns[evict]
-            logging.warning(
-                "More than 8 distinct fetch callables compiled; pass a stable "
-                "function to runner.run(fetches=...) instead of per-call lambdas "
-                "(each new identity recompiles the whole training step)")
+        self._cap_fn_cache(self._step_fns, "runner.run(fetches=...)")
+        return jitted
+
+    def _build_many(self, fetch_fn: Optional[Callable] = None):
+        """Fused multi-step program: one ``lax.scan`` of the step body over a
+        stacked batch block. Compiled once per (fetch fn, block length) — jit
+        retraces per scan length, so varying block sizes (cadence-clipped tail
+        blocks) reuse their own executables."""
+        step_fn = self._make_step_body(fetch_fn)
+
+        def many_fn(state: TrainState, block: PyTree):
+            return jax.lax.scan(step_fn, state, block)
+
+        donate = (0,) if self._donate else ()
+        jitted = jax.jit(
+            many_fn,
+            in_shardings=(self._state_shardings, None),
+            out_shardings=(self._state_shardings, None),
+            donate_argnums=donate,
+        )
+        self._many_fns[fetch_fn] = jitted
+        self._cap_fn_cache(self._many_fns, "runner.run_many(fetches=...)")
         return jitted
 
     def _leading_dims(self, batch: PyTree):
@@ -329,6 +386,33 @@ class DistributedRunner:
         # likeliest batch) so the divisibility error below names it.
         return max(modal)
 
+    def _micro_batch_dim(self, batch: PyTree, k: int, dp: int) -> int:
+        """The leading dim that micro-splits for accumulation (0 when off).
+        Shared by shard_batch and shard_block so the per-step and fused paths
+        can never infer different batch dims for the same runner."""
+        if k <= 1:
+            return 0
+        dims = self._leading_dims(batch)
+        batch_dim = self._infer_batch_dim(dims, k * dp)
+        if batch_dim not in dims:
+            # A typo'd explicit batch_size would otherwise silently disable
+            # micro-splitting while the accumulation scan still runs k
+            # identical full-batch micro-steps.
+            raise ValueError(
+                f"batch_size={batch_dim} matches no leaf's leading dim "
+                f"(present: {sorted(dims)}); nothing would be "
+                f"micro-split for accumulation_steps={k}")
+        return batch_dim
+
+    @staticmethod
+    def _require_micro_divisible(n: int, k: int, dp: int):
+        if n % (k * dp) != 0:
+            raise ValueError(
+                f"Global batch {n} is not divisible into "
+                f"accumulation_steps={k} micro-batches over {dp} data "
+                f"replicas; make it divisible by {k * dp} (or drop "
+                f"accumulation)")
+
     def shard_batch(self, batch: PyTree,
                     accumulation: Optional[int] = None) -> PyTree:
         """Feed remapping: split batch leaves across data replicas, duplicate the
@@ -352,18 +436,7 @@ class DistributedRunner:
         # micro-step would see the full batch with a slice of the negatives.
         # Ambiguity (two splittable dims equally common) raises rather than
         # guessing; ``batch_size=`` on the runner resolves it explicitly.
-        batch_dim = 0
-        if k > 1:
-            dims = self._leading_dims(batch)
-            batch_dim = self._infer_batch_dim(dims, k * dp)
-            if batch_dim not in dims:
-                # A typo'd explicit batch_size would otherwise silently disable
-                # micro-splitting while the accumulation scan still runs k
-                # identical full-batch micro-steps.
-                raise ValueError(
-                    f"batch_size={batch_dim} matches no leaf's leading dim "
-                    f"(present: {sorted(dims)}); nothing would be "
-                    f"micro-split for accumulation_steps={k}")
+        batch_dim = self._micro_batch_dim(batch, k, dp)
 
         def put(leaf):
             if _is_micro(leaf):
@@ -373,12 +446,7 @@ class DistributedRunner:
                 leaf = np.asarray(leaf)
                 shape = leaf.shape
             if k > 1 and len(shape) >= 1 and shape[0] == batch_dim:
-                if shape[0] % (k * dp) != 0:
-                    raise ValueError(
-                        f"Global batch {shape[0]} is not divisible into "
-                        f"accumulation_steps={k} micro-batches over {dp} data "
-                        f"replicas; make it divisible by {k * dp} (or drop "
-                        f"accumulation)")
+                self._require_micro_divisible(shape[0], k, dp)
                 micro = leaf.reshape((k, shape[0] // k) + tuple(shape[1:]))
                 spec = P(None, *self.plan.batch_pspec(len(shape)))
                 return MicroBatched(
@@ -393,6 +461,84 @@ class DistributedRunner:
             return place_host_value(leaf, sharding)
 
         return jax.tree_util.tree_map(put, batch, is_leaf=_is_micro)
+
+    def shard_block(self, batches) -> BatchBlock:
+        """Stack K host batches into one on-device :class:`BatchBlock` for
+        :meth:`run_many`.
+
+        The feed remapping is ``shard_batch``'s, shifted one axis right: every
+        leaf gains a leading (unsharded) step axis of length K, batch leaves
+        shard their *second* dim over the data axes, non-batch leaves
+        replicate, and micro-batched leaves (gradient accumulation) lay out
+        ``[K, accum, B/accum, ...]``. Stacking happens on the host before one
+        placement per leaf, so a block costs the same number of host->device
+        transfers as a single batch."""
+        batches = list(batches)
+        if not batches:
+            raise ValueError("shard_block needs at least one batch")
+        treedef = jax.tree_util.tree_structure(batches[0], is_leaf=_is_micro)
+        for i, b in enumerate(batches[1:], 1):
+            td = jax.tree_util.tree_structure(b, is_leaf=_is_micro)
+            if td != treedef:
+                raise ValueError(
+                    f"shard_block: batch {i}'s pytree structure {td} does not "
+                    f"match batch 0's {treedef}; a block scans one compiled "
+                    f"step over uniformly-shaped batches")
+        K = len(batches)
+        dp = synchronization.mesh_dp_size(self.mesh)
+        k = self._accum
+        batch_dim = self._micro_batch_dim(batches[0], k, dp)
+
+        def put(*leaves):
+            import jax.numpy as jnp
+            # Device-resident leaves (HBM-cached records, re-fed fetches) stack
+            # on-device: stack/reshape dispatch asynchronously and device_put
+            # relayouts without the host round-trip np.asarray would force —
+            # the block analogue of shard_batch's already-resident fast path.
+            # Mixed host/device leaves fall back to host stacking.
+            resident = all(isinstance(l.value if _is_micro(l) else l, jax.Array)
+                           for l in leaves)
+            xp = jnp if resident else np
+            arrs = []
+            for leaf in leaves:
+                if _is_micro(leaf):
+                    # Fold a pre-sharded [k, B/k, ...] layout back to logical.
+                    v = leaf.value if resident else np.asarray(leaf.value)
+                    leaf = v.reshape((-1,) + v.shape[2:])
+                arrs.append(leaf if resident else np.asarray(leaf))
+            shape = tuple(arrs[0].shape)
+            ragged = {tuple(a.shape) for a in arrs}
+            if len(ragged) > 1:
+                # The per-step path tolerates shape drift by recompiling; a
+                # block scans ONE compiled step, so name the problem instead
+                # of letting stack() raise a bare shape error mid-training.
+                raise ValueError(
+                    f"shard_block: batches disagree on a leaf's shape "
+                    f"{sorted(ragged)}; a fused block scans one compiled step "
+                    f"over uniformly-shaped batches — pad the ragged batch "
+                    f"(or use unroll=1 / per-step run() for shape-bucketed "
+                    f"data)")
+            stacked = xp.stack(arrs)
+
+            def place(value, spec):
+                sharding = NamedSharding(self.mesh, spec)
+                if resident:
+                    return jax.device_put(value, sharding)
+                return place_host_value(value, sharding)
+
+            if k > 1 and len(shape) >= 1 and shape[0] == batch_dim:
+                self._require_micro_divisible(shape[0], k, dp)
+                micro = stacked.reshape((K, k, shape[0] // k) + shape[1:])
+                return MicroBatched(place(
+                    micro, P(None, None, *self.plan.batch_pspec(len(shape)))))
+            if len(shape) >= 1 and shape[0] % dp == 0:
+                spec = P(None, *self.plan.batch_pspec(len(shape)))
+            else:
+                spec = P()
+            return place(stacked, spec)
+
+        tree = jax.tree_util.tree_map(put, *batches, is_leaf=_is_micro)
+        return BatchBlock(tree, K)
 
     def logical_params(self, state_or_params) -> PyTree:
         """The parameter tree at its original (user-facing, unpadded) shapes."""
@@ -424,6 +570,39 @@ class DistributedRunner:
         with self.mesh:
             new_state, (loss, aux, fetched) = step_fn(state, sharded)
         default = (loss, aux) if self._has_aux else loss
+        if fetches is not None:
+            return new_state, (default, fetched)
+        return new_state, default
+
+    def run_many(self, state: TrainState, batches,
+                 fetches: Optional[Callable] = None) -> Tuple[TrainState, Any]:
+        """K fused training steps in ONE compiled dispatch.
+
+        ``batches`` is a sequence of K host batches, or a pre-sharded
+        :class:`BatchBlock` from :meth:`shard_block` /
+        ``device_prefetch(unroll=K)``. The step body is scanned on-device, so
+        Python dispatch, feed remapping, and fetch materialization are paid
+        once per K steps — and the result is bit-identical to K sequential
+        :meth:`run` calls (same body, same shardings; test-pinned).
+
+        The fetch contract is :meth:`run`'s with a leading ``[K]`` step axis:
+        losses return as a ``[K]`` stack, aux and ``fetches=fn`` results stack
+        per step (each slice computed from that step's pre-update params)."""
+        if not self.supports_run_many:
+            raise RuntimeError(
+                f"{type(self).__name__} does not support run_many: the async "
+                f"regime's parameter service applies gradients step-by-step; "
+                f"use run() (or train(..., unroll=1))")
+        if self._state_shardings is None:
+            raise RuntimeError("Call init(params) before run_many()")
+        block = batches if isinstance(batches, BatchBlock) \
+            else self.shard_block(batches)
+        many_fn = self._many_fns.get(fetches)
+        if many_fn is None:
+            many_fn = self._build_many(fetches)
+        with self.mesh:
+            new_state, (losses, auxes, fetched) = many_fn(state, block.tree)
+        default = (losses, auxes) if self._has_aux else losses
         if fetches is not None:
             return new_state, (default, fetched)
         return new_state, default

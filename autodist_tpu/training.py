@@ -21,6 +21,19 @@ from autodist_tpu.utils.metrics import ThroughputMeter
 PyTree = Any
 
 
+def _make_meter(first_batch: PyTree, batch_size: Optional[int],
+                log_every: int) -> ThroughputMeter:
+    """Meter sized lazily from the first batch: the largest leading dim fixes
+    the example count per step (shared by the per-step and unrolled loops so
+    their examples/s can never diverge for identical configs)."""
+    n = batch_size
+    if n is None:
+        leaves = [l for l in jax.tree_util.tree_leaves(first_batch)
+                  if getattr(l, "ndim", 0) >= 1]
+        n = max((l.shape[0] for l in leaves), default=1)
+    return ThroughputMeter(batch_size=n, log_every=log_every, log=False)
+
+
 def train(runner, params: PyTree,
           batches: Union[Callable[[int], PyTree], Iterable[PyTree]],
           steps: int,
@@ -37,7 +50,8 @@ def train(runner, params: PyTree,
           eval_every: int = 0,
           eval_batch: Any = None,
           eval_fn: Optional[Callable] = None,
-          on_eval: Optional[Callable[[int, Any], None]] = None) -> TrainState:
+          on_eval: Optional[Callable[[int, Any], None]] = None,
+          unroll: int = 1) -> TrainState:
     """Run ``steps`` global steps, checkpointing and resuming automatically.
 
     ``batches``: either ``fn(step_index) -> batch`` or an iterable of batches
@@ -55,7 +69,22 @@ def train(runner, params: PyTree,
     forward-only :meth:`evaluate` runs every ``eval_every`` steps on the
     current params (``eval_fn`` defaults to the loss) and ``on_eval(step,
     value)`` receives the result. Returns the final :class:`TrainState`.
+
+    ``unroll=K`` (K > 1) switches the loop to the fused dispatch-ahead
+    pipeline: K consecutive batches are stacked into one pre-sharded block and
+    run as ONE compiled K-step program (:meth:`DistributedRunner.run_many` —
+    bit-identical to K per-step calls), while the host gathers and pre-shards
+    the next block behind the running one. Checkpoint and eval cadence points
+    force block boundaries, so saves/evals fire at exactly the per-step
+    loop's steps and resume semantics are unchanged (step i still consumes
+    batch i); only logging moves to block granularity (the first block is the
+    meter's warmup, periods close at the first block end with ``log_every``
+    post-warmup steps, and ``on_metrics`` receives the block's last loss).
+    Runners without fused support (async-PS, remote workers) fall back to the
+    per-step loop with a warning.
     """
+    if unroll < 1:
+        raise ValueError("unroll must be >= 1")
     if eval_every and eval_batch is None:
         raise ValueError("eval_every needs an eval_batch")
     if is_chief is None:
@@ -94,6 +123,30 @@ def train(runner, params: PyTree,
                 next(batch_iter)
             except StopIteration:
                 return state
+    use_blocks = (unroll > 1 and getattr(runner, "supports_run_many", False)
+                  and not getattr(runner, "_is_remote_worker", False))
+    if unroll > 1 and not use_blocks:
+        logging.warning(
+            "train: unroll=%d requested but %s has no fused multi-step path "
+            "(async/remote regime); falling back to per-step dispatch",
+            unroll, type(runner).__name__)
+
+    def _finish(final_state: TrainState) -> TrainState:
+        # Final save stays synchronous: train() returning means the state is
+        # durably on disk (save() joins any in-flight periodic write first).
+        if saver is not None and save_participant and int(final_state.step) > start:
+            saver.save(final_state, prefix_base, runner=runner)
+        if saver is not None:
+            saver.wait()
+        return final_state
+
+    if use_blocks:
+        return _finish(_unrolled_loop(
+            runner, state, next_batch, batch_iter, start, steps, unroll,
+            saver, prefix_base, save_participant, save_every, async_save,
+            log_every, batch_size, on_metrics, eval_every, eval_batch,
+            eval_fn, on_eval))
+
     meter = None
     loss = None
     for step_i in range(start, steps):
@@ -108,13 +161,7 @@ def train(runner, params: PyTree,
         state, fetched = runner.run(state, batch)
         loss = fetched[0] if isinstance(fetched, tuple) else fetched
         if meter is None and log_every:
-            # Lazily sized: the first batch fixes the example count per step.
-            n = batch_size
-            if n is None:
-                leaves = [l for l in jax.tree_util.tree_leaves(batch)
-                          if getattr(l, "ndim", 0) >= 1]
-                n = max((l.shape[0] for l in leaves), default=1)
-            meter = ThroughputMeter(batch_size=n, log_every=log_every, log=False)
+            meter = _make_meter(batch, batch_size, log_every)
         if meter is not None:
             # The meter syncs (device->host read of the loss) only at its period
             # boundaries — one boundary per log_every steps, not per step — and
@@ -144,10 +191,94 @@ def train(runner, params: PyTree,
             saver.save(state, prefix_base, runner=runner,
                        async_write=async_save)
 
-    if saver is not None and save_participant and int(state.step) > start:
-        # Final save stays synchronous: train() returning means the state is
-        # durably on disk (save() joins any in-flight periodic write first).
-        saver.save(state, prefix_base, runner=runner)
-    if saver is not None:
-        saver.wait()
+    return _finish(state)
+
+
+def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
+                   start: int, steps: int, unroll: int,
+                   saver, prefix_base, save_participant, save_every: int,
+                   async_save: bool, log_every: int, batch_size: Optional[int],
+                   on_metrics, eval_every: int, eval_batch, eval_fn,
+                   on_eval) -> TrainState:
+    """The fused dispatch-ahead pipeline behind ``train(..., unroll=K)``.
+
+    Consecutive batches are gathered into blocks of up to ``unroll`` steps and
+    run as one compiled K-step scan (:meth:`DistributedRunner.run_many`);
+    while the device executes a block, the host gathers and pre-shards the
+    next one (a one-block dispatch-ahead queue — dispatch is asynchronous, so
+    the prep overlaps device compute). Blocks are clipped so they END exactly
+    at every ``save_every``/``eval_every`` multiple and at ``steps``, which
+    keeps checkpoint/eval/resume semantics identical to the per-step loop;
+    losses are read back (``jax.device_get``) only when a ``log_every``
+    period closes at a block boundary."""
+    boundaries = [p for p in (save_every if saver is not None else 0,
+                              eval_every) if p]
+
+    def next_boundary(i: int) -> int:
+        nxt = steps
+        for p in boundaries:
+            nxt = min(nxt, (i // p + 1) * p)
+        return nxt
+
+    exhausted = False
+    first_batch = None
+
+    def gather(i: int):
+        """Up to min(unroll, steps-to-next-cadence-point) host batches
+        starting at step index ``i``; None when the run is over."""
+        nonlocal exhausted, first_batch
+        if exhausted or i >= steps:
+            return None
+        blk = []
+        for j in range(min(unroll, next_boundary(i) - i)):
+            if next_batch is not None:
+                blk.append(next_batch(i + j))
+            else:
+                try:
+                    blk.append(next(batch_iter))
+                except StopIteration:
+                    exhausted = True
+                    logging.info("train: batch iterator exhausted at step %d",
+                                 i + len(blk))
+                    break
+        if not blk:
+            return None
+        if first_batch is None:
+            first_batch = blk[0]
+        return runner.shard_block(blk)
+
+    meter = None
+    step_i = start
+    block = gather(step_i)
+    while block is not None:
+        state, fetched = runner.run_many(state, block)
+        losses = fetched[0] if isinstance(fetched, tuple) else fetched
+        step_i += block.length
+        # Dispatch-ahead: run_many returns as soon as the K-step program is
+        # enqueued; gather + pre-shard the next block NOW, before any sync
+        # below, so host batch assembly and h->d transfer overlap the device.
+        next_block = gather(step_i)
+        if meter is None and log_every:
+            meter = _make_meter(first_batch, batch_size, log_every)
+        if meter is not None:
+            rate = meter.step_many(block.length, sync=losses)
+            if rate is not None:
+                last = float(jax.device_get(losses)[-1])
+                logging.info("train: step %d loss %.4f %.1f examples/s",
+                             step_i, last, rate)
+                if on_metrics is not None:
+                    on_metrics(step_i, last, rate)
+        if eval_every and step_i % eval_every == 0:
+            val = runner.evaluate(state, eval_batch, eval_fn)
+            try:
+                logging.info("train: step %d eval %.6f", step_i, float(val))
+            except (TypeError, ValueError):
+                logging.info("train: step %d eval (pytree)", step_i)
+            if on_eval is not None:
+                on_eval(step_i, val)
+        if (saver is not None and save_participant and save_every
+                and step_i % save_every == 0 and step_i < steps):
+            saver.save(state, prefix_base, runner=runner,
+                       async_write=async_save)
+        block = next_block
     return state

@@ -14,6 +14,19 @@ from typing import List, Optional
 from autodist_tpu.utils import logging
 
 
+def _sync(value) -> None:
+    """Force a device->host read of ``value`` (a completion fence for the
+    asynchronously dispatched step it came from); a no-op when jax is absent
+    or the value is host-side already."""
+    if value is None:
+        return
+    try:
+        import jax
+        jax.device_get(value)
+    except Exception:
+        pass
+
+
 class ThroughputMeter:
     """examples/sec (or tokens/sec) per log period plus a run average."""
 
@@ -32,6 +45,7 @@ class ThroughputMeter:
         self._period_start: float = now
         self._run_start: float = now
         self._run_steps = 0
+        self._period_steps = 0   # block-mode (step_many) period accounting
         self.history: List[float] = []
 
     def step(self, sync=None) -> Optional[float]:
@@ -44,12 +58,8 @@ class ThroughputMeter:
         self._step += 1
         at_boundary = (self._step > self._warmup
                        and (self._run_steps + 1) % self._log_every == 0)
-        if (at_boundary or self._step == self._warmup) and sync is not None:
-            try:
-                import jax
-                jax.device_get(sync)
-            except Exception:
-                pass
+        if at_boundary or self._step == self._warmup:
+            _sync(sync)
         now = time.perf_counter()
         if self._step <= self._warmup:
             # Exclude compile/warmup from rates (reference TimeHistory did the same
@@ -67,6 +77,44 @@ class ThroughputMeter:
             self._period_start = now
             return rate
         return None
+
+    def step_many(self, n: int, sync=None) -> Optional[float]:
+        """Record ``n`` steps completed as ONE fused dispatch
+        (``runner.run_many`` block mode); returns the period rate when one or
+        more ``log_every`` periods closed inside this block.
+
+        The block analogue of :meth:`step`: the first call is wholly warmup
+        (it carries the block compile), a period closes at the first block
+        boundary with >= ``log_every`` post-warmup steps since the last
+        period, and the rate uses the actual step count — block-granular
+        logging stays unbiased even when cadence-clipped blocks make periods
+        ragged. ``sync`` is read back (device->host) only when a period
+        closes."""
+        if n < 1:
+            return None
+        first = self._step == 0
+        self._step += n
+        if first and self._warmup:
+            _sync(sync)
+            now = time.perf_counter()
+            self._period_start = now
+            self._run_start = now
+            self._run_steps = 0
+            self._period_steps = 0
+            return None
+        self._run_steps += n
+        self._period_steps += n
+        if self._period_steps < self._log_every:
+            return None
+        _sync(sync)
+        now = time.perf_counter()
+        rate = self._period_steps * self._batch_size / (now - self._period_start)
+        self.history.append(rate)
+        if self._log:
+            logging.info("step %d: %.1f %s/sec", self._step, rate, self._unit)
+        self._period_start = now
+        self._period_steps = 0
+        return rate
 
     @property
     def average(self) -> Optional[float]:

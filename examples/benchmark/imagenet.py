@@ -89,7 +89,12 @@ def main(argv=None):
                              "default = fresh init (chance accuracy)")
     parser.add_argument("--norm", choices=["group", "batch"], default="group",
                         help="resnet normalization: group (pure function) or "
-                             "batch (cross-replica sync-BN)")
+                             "batch (cross-replica sync-BN). Caveat: sync-BN "
+                             "tracks no running statistics, so --eval on a "
+                             "--norm batch checkpoint normalizes with the "
+                             "EVAL batch's own mean/var — accuracy depends "
+                             "on eval batch size/composition (see "
+                             "docs/usage/performance.md)")
     parser.add_argument("--input_mode", choices=["cache", "stream"],
                         default="cache",
                         help="--data_dir feed: 'cache' = HBM-resident record "

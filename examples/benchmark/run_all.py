@@ -167,14 +167,27 @@ def main(argv=None):
     snapshot = None
     threshold = 2.0
     n_dev, backend = _probe_devices()
-    if backend == "cpu":
-        print("\n(CPU backend: PERF_BASELINE comparison skipped — recorded "
-              "bests are chip rates)")
+    if backend in ("cpu", "unknown"):
+        # "unknown" means the probe subprocess itself failed: comparing host
+        # rates against recorded per-chip accelerator bests would print
+        # spurious REGRESSION rows, so treat it like CPU — but surface the
+        # probe failure instead of silently skipping.
+        if backend == "unknown":
+            print("\nWARNING: device probe failed (could not determine the "
+                  "backend); PERF_BASELINE comparison skipped — recorded "
+                  "bests are accelerator chip rates", file=sys.stderr)
+        else:
+            print("\n(CPU backend: PERF_BASELINE comparison skipped — "
+                  "recorded bests are chip rates)")
     elif args.baseline and os.path.exists(args.baseline):
         with open(args.baseline) as f:
             snapshot = json.load(f)
         baseline = snapshot.get("rows", {})
         threshold = snapshot.get("threshold_pct", 2.0)
+    elif args.baseline and args.update_baseline:
+        # First measured run on a fresh checkout: start a snapshot so every
+        # config gains a gate row now rather than never.
+        snapshot = {"threshold_pct": threshold, "rows": {}}
 
     width = max(len(r["name"]) for r in results)
     regressions = []
@@ -201,19 +214,31 @@ def main(argv=None):
               f"vs {args.baseline}: "
               + ", ".join(f"{n} ({p:+.1f}%)" for n, p in regressions))
     if args.update_baseline and snapshot is not None:
-        raised = []
+        raised, created = [], []
         for r in results:
-            row = snapshot.setdefault("rows", {}).get(r["name"])
             per_chip = (r["rate"] / max(n_dev, 1)
                         if r["rate"] is not None else None)
-            if per_chip is not None and row and per_chip > row["rate"]:
+            if per_chip is None:
+                continue
+            row = snapshot.setdefault("rows", {}).get(r["name"])
+            if row is None:
+                # A renamed/new benchmark config must enter the regression
+                # gate on its first measured run, not silently escape it.
+                snapshot["rows"][r["name"]] = {
+                    "rate": round(per_chip, 1), "unit": r["unit"],
+                    "recorded": "run_all --update_baseline (per-chip, new row)"}
+                created.append(r["name"])
+            elif per_chip > row["rate"]:
                 row["rate"] = round(per_chip, 1)
                 row["recorded"] = "run_all --update_baseline (per-chip)"
                 raised.append(r["name"])
-        if raised:
+        if raised or created:
             with open(args.baseline, "w") as f:
                 json.dump(snapshot, f, indent=1)
-            print(f"baseline raised for: {', '.join(raised)}")
+            if raised:
+                print(f"baseline raised for: {', '.join(raised)}")
+            if created:
+                print(f"baseline rows created for: {', '.join(created)}")
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1)

@@ -292,6 +292,35 @@ def test_indivisible_batch_raises():
         runner.run(state, _dense_data())  # 32 splits by dp=8 but not by 3*8
 
 
+def test_run_many_composes_with_accumulation_bit_exact():
+    """In-window canary for the fused multi-step path (the full suite in
+    tests/test_unrolled.py sorts past the tier-1 time budget): run_many over
+    an accumulating runner must be BIT-identical to the sequential steps —
+    the scan is a dispatch transform, not a numeric one."""
+    def run(fused):
+        ad = AutoDist(strategy_builder=AllReduce())
+        runner = ad.create_distributed_session(
+            _dense_loss, _dense_params(), optax.adam(1e-2),
+            example_batch=_dense_data(), accumulation_steps=2)
+        state = runner.init(_dense_params())
+        batches = [_dense_data(seed=i) for i in range(3)]
+        if fused:
+            state, losses = runner.run_many(state, batches)
+            losses = list(jax.device_get(losses))
+        else:
+            losses = []
+            for b in batches:
+                state, loss = runner.run(state, b)
+                losses.append(jax.device_get(loss))
+        return jax.device_get(runner.logical_params(state)), losses
+
+    p_seq, l_seq = run(fused=False)
+    p_fused, l_fused = run(fused=True)
+    np.testing.assert_array_equal(np.stack(l_fused), np.stack(l_seq))
+    for k in p_seq:
+        np.testing.assert_array_equal(p_fused[k], p_seq[k])
+
+
 def test_async_regime_rejects_accumulation():
     ad = AutoDist(strategy_builder=PS(sync=False))
     with pytest.raises(ValueError, match="synchronous"):

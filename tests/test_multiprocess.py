@@ -139,12 +139,14 @@ def test_cross_process_bounded_staleness_ps(tmp_path):
         # Sustained host oversubscription can deschedule the fast worker for
         # seconds, letting the slow worker lap it — the wall-clock signature
         # is then legitimately absent (the gate never needed to block). The
-        # gate SEMANTICS are still assertable without a clock: at the k-th
-        # fast step, the version read can trail the worker's own completed
-        # count by at most `staleness`.
+        # gate SEMANTICS are still assertable without a clock: the version
+        # read at the fast worker's k-th step already includes its own k
+        # prior applies (step = pull->apply), so the slow worker's share is
+        # v - k, and the gate bounds the fast worker's lead over it:
+        # k - (v - k) <= staleness.
         versions = result["versions_read"]
         for k, v in enumerate(versions):
-            assert k - v <= aps.STALENESS, (k, v, versions)
+            assert 2 * k - v <= aps.STALENESS, (k, v, versions)
         print(f"timing signature unavailable under sustained load; "
               f"version invariant held: {versions}")
 

@@ -1,0 +1,321 @@
+"""Fused multi-step execution: ``run_many`` + ``train(unroll=K)``.
+
+The fused path dispatches K optimizer steps as ONE compiled ``lax.scan`` over
+the existing step body, so it must be a pure performance transform: bit-identical
+final state to K sequential ``run()`` calls (same step body, same shardings —
+asserted exactly, not approximately), the same fetch contract with a leading
+``[K]`` stack axis, and ``train(..., unroll=K)`` preserving the per-step loop's
+checkpoint/eval/resume semantics (cadence points force block boundaries).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist, train
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.runner import BatchBlock
+from autodist_tpu.strategy import AllReduce, PS
+
+BATCH = 32
+
+
+def _loss(p, b):
+    return jnp.mean((b["y"] - (b["x"] @ p["w"] + p["b"])) ** 2)
+
+
+def _params():
+    rng = np.random.RandomState(7)
+    return {"w": rng.randn(4, 1).astype(np.float32),
+            "b": np.zeros((1,), np.float32)}
+
+
+def _batch_fn(i):
+    rng = np.random.RandomState(100 + i)
+    return {"x": rng.randn(BATCH, 4).astype(np.float32),
+            "y": rng.randn(BATCH, 1).astype(np.float32)}
+
+
+def _session(accum=1, has_aux=False, loss=None):
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(
+        loss if loss is not None else _loss, _params(), optax.adam(1e-2),
+        example_batch=_batch_fn(0), accumulation_steps=accum, has_aux=has_aux)
+    return runner, runner.init(_params())
+
+
+def _assert_trees_equal(a, b):
+    """Bitwise equality, leaf by leaf (the fused path is a dispatch transform,
+    not a numeric one)."""
+    a, b = jax.device_get(a), jax.device_get(b)
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("accum", [1, 2])
+def test_run_many_bit_exact_vs_sequential(accum):
+    K = 6
+    batches = [_batch_fn(i) for i in range(K)]
+
+    runner_a, state_a = _session(accum=accum)
+    seq_losses = []
+    for b in batches:
+        state_a, loss = runner_a.run(state_a, b)
+        seq_losses.append(jax.device_get(loss))
+
+    runner_b, state_b = _session(accum=accum)
+    state_b, losses = runner_b.run_many(state_b, batches)
+
+    assert losses.shape == (K,)
+    np.testing.assert_array_equal(jax.device_get(losses), np.stack(seq_losses))
+    _assert_trees_equal(state_b.params, state_a.params)
+    _assert_trees_equal(state_b.opt_state, state_a.opt_state)
+    assert int(state_b.step) == int(state_a.step) == K
+
+
+def test_run_many_single_step_matches_run():
+    runner_a, state_a = _session()
+    state_a, loss_a = runner_a.run(state_a, _batch_fn(0))
+    runner_b, state_b = _session()
+    state_b, losses_b = runner_b.run_many(state_b, [_batch_fn(0)])
+    np.testing.assert_array_equal(jax.device_get(losses_b),
+                                  jax.device_get(loss_a)[None])
+    _assert_trees_equal(state_b.params, state_a.params)
+
+
+def test_run_many_repeated_blocks_with_donation():
+    """Consecutive run_many calls donate the carried state (default) and still
+    match 2K sequential steps exactly."""
+    K = 3
+    batches = [_batch_fn(i) for i in range(2 * K)]
+    runner_a, state_a = _session()
+    for b in batches:
+        state_a, _ = runner_a.run(state_a, b)
+    runner_b, state_b = _session()
+    state_b, _ = runner_b.run_many(state_b, batches[:K])
+    state_b, _ = runner_b.run_many(state_b, batches[K:])
+    _assert_trees_equal(state_b.params, state_a.params)
+    assert int(state_b.step) == 2 * K
+
+
+def test_run_many_fetches_stack_per_step():
+    """fetches=fn returns with a leading [K] axis; slice k equals the k-th
+    sequential run's fetch (computed from that step's pre-update params)."""
+    K = 3
+    batches = [_batch_fn(i) for i in range(K)]
+    preds = lambda p, b: b["x"] @ p["w"] + p["b"]  # noqa: E731
+
+    runner_a, state_a = _session()
+    seq = []
+    for b in batches:
+        state_a, (_, fetched) = runner_a.run(state_a, b, fetches=preds)
+        seq.append(jax.device_get(fetched))
+
+    runner_b, state_b = _session()
+    state_b, (losses, stacked) = runner_b.run_many(state_b, batches,
+                                                   fetches=preds)
+    assert stacked.shape == (K, BATCH, 1)
+    np.testing.assert_array_equal(jax.device_get(stacked), np.stack(seq))
+    _assert_trees_equal(state_b.params, state_a.params)
+
+
+def test_run_many_aux_stacks_and_matches():
+    def loss_with_aux(p, b):
+        pred = b["x"] @ p["w"] + p["b"]
+        per_ex = ((b["y"] - pred) ** 2)[:, 0]
+        return jnp.mean(per_ex), {"mean_abs": jnp.mean(jnp.abs(per_ex)),
+                                  "per_example": per_ex}
+
+    K = 3
+    batches = [_batch_fn(i) for i in range(K)]
+    runner_a, state_a = _session(has_aux=True, loss=loss_with_aux)
+    seq_aux = []
+    for b in batches:
+        state_a, (_, aux) = runner_a.run(state_a, b)
+        seq_aux.append(jax.device_get(aux))
+
+    runner_b, state_b = _session(has_aux=True, loss=loss_with_aux)
+    state_b, (losses, auxes) = runner_b.run_many(state_b, batches)
+    assert losses.shape == (K,)
+    assert auxes["per_example"].shape == (K, BATCH)
+    assert auxes["mean_abs"].shape == (K,)
+    for k in range(K):
+        np.testing.assert_array_equal(auxes["per_example"][k],
+                                      seq_aux[k]["per_example"])
+        np.testing.assert_array_equal(auxes["mean_abs"][k],
+                                      seq_aux[k]["mean_abs"])
+    _assert_trees_equal(state_b.params, state_a.params)
+
+
+def test_run_many_accepts_prestacked_block():
+    """A BatchBlock from shard_block (the device_prefetch unroll path) feeds
+    run_many directly, skipping re-stacking."""
+    K = 4
+    batches = [_batch_fn(i) for i in range(K)]
+    runner, state = _session()
+    block = runner.shard_block(batches)
+    assert isinstance(block, BatchBlock) and len(block) == K
+    state, losses = runner.run_many(state, block)
+    assert losses.shape == (K,)
+
+    runner_a, state_a = _session()
+    for b in batches:
+        state_a, _ = runner_a.run(state_a, b)
+    _assert_trees_equal(state.params, state_a.params)
+
+
+def test_shard_block_device_resident_batches_stay_on_device():
+    """Device-resident batch leaves stack on-device (no host readback) and
+    produce the same block results as host batches."""
+    K = 3
+    host = [_batch_fn(i) for i in range(K)]
+    runner, state = _session()
+    resident = [jax.tree_util.tree_map(jnp.asarray, b) for b in host]
+    block = runner.shard_block(resident)
+    for leaf in jax.tree_util.tree_leaves(block.tree):
+        assert leaf.shape[0] == K
+    state, losses = runner.run_many(state, block)
+
+    runner_h, state_h = _session()
+    state_h, losses_h = runner_h.run_many(state_h, host)
+    np.testing.assert_array_equal(jax.device_get(losses),
+                                  jax.device_get(losses_h))
+    _assert_trees_equal(state.params, state_h.params)
+
+
+def test_device_prefetch_unroll_yields_blocks():
+    from autodist_tpu.data.loader import DataLoader, device_prefetch
+    rng = np.random.RandomState(5)
+    loader = DataLoader({"x": rng.randn(96, 4).astype(np.float32),
+                         "y": rng.randn(96, 1).astype(np.float32)},
+                        batch_size=BATCH, native=False)
+    try:
+        runner, state = _session()
+        it = device_prefetch(loader, runner, depth=2, unroll=2)
+        block = next(it)
+        assert isinstance(block, BatchBlock) and len(block) == 2
+        state, losses = runner.run_many(state, block)
+        assert losses.shape == (2,)
+    finally:
+        loader.close()
+
+
+def test_shard_block_rejects_mismatched_structures():
+    runner, _ = _session()
+    good = _batch_fn(0)
+    bad = {"x": good["x"]}  # missing "y"
+    with pytest.raises(ValueError, match="structure"):
+        runner.shard_block([good, bad])
+
+
+def test_shard_block_rejects_ragged_shapes():
+    """A smaller final batch (fine per-step via recompile) must fail a block
+    with a named error, not a bare stack() shape complaint."""
+    runner, _ = _session()
+    small = {k: v[: BATCH // 2] for k, v in _batch_fn(1).items()}
+    with pytest.raises(ValueError, match="uniformly-shaped"):
+        runner.shard_block([_batch_fn(0), small])
+
+
+def test_async_runner_rejects_run_many():
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    runner = ad.create_distributed_session(
+        _loss, _params(), optax.sgd(0.1), example_batch=_batch_fn(0))
+    assert not runner.supports_run_many
+    with pytest.raises(RuntimeError, match="async"):
+        runner.run_many(None, [_batch_fn(0)])
+
+
+# --------------------------------------------------------------- train(unroll=)
+
+def _runner():
+    ad = AutoDist(strategy_builder=AllReduce())
+    return ad.create_distributed_session(_loss, _params(), optax.adam(1e-2),
+                                         example_batch=_batch_fn(0))
+
+
+def test_train_unrolled_matches_per_step():
+    per_step = train(_runner(), _params(), _batch_fn, steps=10, log_every=0)
+    fused = train(_runner(), _params(), _batch_fn, steps=10, log_every=0,
+                  unroll=4)  # blocks of 4, 4, 2 — steps cap clips the last
+    assert int(fused.step) == 10
+    _assert_trees_equal(fused.params, per_step.params)
+
+
+def test_train_unrolled_partial_final_block_on_exhaustion():
+    """An iterator that ends mid-block runs the partial remainder and stops
+    with exact step accounting."""
+    per_step = train(_runner(), _params(), [_batch_fn(i) for i in range(5)],
+                     steps=100, log_every=0)
+    fused = train(_runner(), _params(), [_batch_fn(i) for i in range(5)],
+                  steps=100, log_every=0, unroll=4)  # blocks of 4 then 1
+    assert int(fused.step) == 5
+    _assert_trees_equal(fused.params, per_step.params)
+
+
+def test_train_unrolled_resume_mid_run(tmp_path):
+    """Save cadence points force block boundaries, so an interrupted unrolled
+    run resumes at the same step a per-step run would — and lands on the same
+    final state."""
+    direct = train(_runner(), _params(), _batch_fn, steps=10, log_every=0)
+
+    ckpt = str(tmp_path / "ckpts")
+    first = train(_runner(), _params(), _batch_fn, steps=7, log_every=0,
+                  unroll=4, checkpoint_dir=ckpt, save_every=3)
+    assert int(first.step) == 7
+    # Periodic saves fired at the per-step cadence (3, 6), final at 7.
+    assert Saver.latest_checkpoint(ckpt).endswith("model-7")
+
+    resumed = train(_runner(), _params(), _batch_fn, steps=10, log_every=0,
+                    unroll=4, checkpoint_dir=ckpt, save_every=3)
+    assert int(resumed.step) == 10
+    _assert_trees_equal(resumed.params, direct.params)
+
+
+def test_train_unrolled_iterator_resume_fast_forwards(tmp_path):
+    direct = train(_runner(), _params(), [_batch_fn(i) for i in range(8)],
+                   steps=8, log_every=0, unroll=3)
+    ckpt = str(tmp_path / "ckpts")
+    train(_runner(), _params(), [_batch_fn(i) for i in range(8)], steps=4,
+          checkpoint_dir=ckpt, log_every=0, unroll=3)
+    resumed = train(_runner(), _params(), [_batch_fn(i) for i in range(8)],
+                    steps=8, checkpoint_dir=ckpt, log_every=0, unroll=3)
+    assert int(resumed.step) == 8
+    _assert_trees_equal(resumed.params, direct.params)
+
+
+def test_train_unrolled_eval_cadence_unchanged():
+    """eval_every boundaries clip blocks, so evals fire at exactly the same
+    steps (and on the same params) as the per-step loop."""
+    evals = []
+    held_out = _batch_fn(999)
+    train(_runner(), _params(), _batch_fn, steps=9, log_every=0, unroll=4,
+          eval_every=3, eval_batch=held_out,
+          on_eval=lambda step, val: evals.append((step, float(val))))
+    assert [s for s, _ in evals] == [3, 6, 9]
+    assert evals[-1][1] < evals[0][1]
+
+
+def test_train_unrolled_metrics_fire_at_block_granularity():
+    """Block mode logs at the first block end with >= log_every post-warmup
+    steps (the first block is warmup); losses sync only at those boundaries."""
+    seen = []
+    train(_runner(), _params(), _batch_fn, steps=8, log_every=3, unroll=4,
+          on_metrics=lambda step, loss, rate: seen.append((step, loss, rate)))
+    # Block 1 (steps 1-4) is warmup; block 2 ends at step 8 with 4 >= 3
+    # post-warmup steps -> one period.
+    assert [s for s, _, _ in seen] == [8]
+    assert all(rate > 0 for _, _, rate in seen)
+    assert all(np.isfinite(loss) for _, loss, _ in seen)
+
+
+def test_train_unroll_one_is_per_step_loop():
+    """unroll=1 must take today's per-step path (meter boundaries at 1+3k)."""
+    seen = []
+    train(_runner(), _params(), _batch_fn, steps=7, log_every=3, unroll=1,
+          on_metrics=lambda step, loss, rate: seen.append(step))
+    assert seen == [4, 7]
