@@ -56,6 +56,10 @@ _ENV_DEFAULTS = {
     # Async-PS transport address ("host:port"); set by the chief's coordinator
     # for worker processes when the strategy requests a non-synchronous regime.
     "AUTODIST_PS_ADDR": "",
+    # Overlapped PS client: stream the next parameter pull on a second socket
+    # while the gradient push / gate round-trips run (default on; "0" forces
+    # the serial pull-then-push client for debugging).
+    "AUTODIST_PS_OVERLAP": True,
     # Dump jaxpr/StableHLO per build stage (reference graph visualizer parity).
     "AUTODIST_DUMP_GRAPHS": False,
 }
@@ -77,6 +81,7 @@ class ENV(enum.Enum):
     AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
     AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
     AUTODIST_PS_ADDR = "AUTODIST_PS_ADDR"
+    AUTODIST_PS_OVERLAP = "AUTODIST_PS_OVERLAP"
     AUTODIST_DUMP_GRAPHS = "AUTODIST_DUMP_GRAPHS"
 
     @property

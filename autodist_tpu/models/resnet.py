@@ -32,8 +32,14 @@ class ResNet50Config:
     # axes, so under pjit with a batch-sharded input the mean/var reduce over
     # the GLOBAL batch (GSPMD inserts the cross-replica collectives). Matches
     # the reference Keras models' train-time normalization; running averages
-    # for eval are intentionally not tracked (the train step stays pure).
+    # for eval are intentionally not tracked DURING TRAINING (the train step
+    # stays pure). For inference parity with reference BatchNorm, a post-hoc
+    # calibration pass (:func:`calibrate_bn_ema`) EMAs (mean, var) per norm
+    # site into a ``bn_ema`` collection carried OUTSIDE params, and
+    # ``bn_ema=True`` makes every SyncBatchNorm normalize with those stored
+    # statistics instead of the eval batch's own.
     norm: str = "group"
+    bn_ema: bool = False
 
 
 class SyncBatchNorm(nn.Module):
@@ -41,9 +47,20 @@ class SyncBatchNorm(nn.Module):
     statistics (no mutable running averages). Under a data-sharded ``pjit``
     the reductions below span the global batch — this is sync-BN, the
     distributed-framework capability the reference delegated to
-    ``CollectiveReduce`` in TF's BN layers."""
+    ``CollectiveReduce`` in TF's BN layers.
+
+    Inference parity (flag-gated, default off): with ``use_ema=True`` the
+    layer normalizes with stored (mean, var) read from the ``bn_ema``
+    collection — reference BatchNorm's inference mode — instead of the eval
+    batch's own moments. The stored statistics live OUTSIDE params (the train
+    step stays a pure function of (params, batch)); :func:`calibrate_bn_ema`
+    produces them post hoc. In batch-stats mode the layer additionally sows
+    its per-batch (mean, var) into a ``bn_stats`` collection — a no-op unless
+    the caller asks for it with ``mutable=["bn_stats"]`` (the calibration
+    pass does; training never does)."""
     dtype: Any = jnp.bfloat16
     epsilon: float = 1e-5
+    use_ema: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -53,15 +70,21 @@ class SyncBatchNorm(nn.Module):
         # f32 statistics regardless of activation dtype (bf16 mean/var over a
         # global batch loses too much precision).
         xf = x.astype(jnp.float32)
-        mean = xf.mean(axis=(0, 1, 2))
-        var = ((xf - mean) ** 2).mean(axis=(0, 1, 2))
+        if self.use_ema:
+            stats = self.variable("bn_ema", "stats",
+                                  lambda: jnp.zeros((2, c), jnp.float32))
+            mean, var = stats.value[0], stats.value[1]
+        else:
+            mean = xf.mean(axis=(0, 1, 2))
+            var = ((xf - mean) ** 2).mean(axis=(0, 1, 2))
+            self.sow("bn_stats", "batch", jnp.stack([mean, var]))
         y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
         return (y * scale + bias).astype(self.dtype)
 
 
 def _make_norm(cfg: ResNet50Config, channels: int, name: str):
     if cfg.norm == "batch":
-        return SyncBatchNorm(dtype=cfg.dtype, name=name)
+        return SyncBatchNorm(dtype=cfg.dtype, use_ema=cfg.bn_ema, name=name)
     return nn.GroupNorm(num_groups=num_groups(channels, cfg.norm_groups),
                         dtype=cfg.dtype, name=name)
 
@@ -112,6 +135,49 @@ class ResNet(nn.Module):
                                     name=f"stage{stage}_block{block}")(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def calibrate_bn_ema(model: "ResNet", params, image_batches,
+                     momentum: float = 0.9):
+    """EMA of every SyncBatchNorm site's (mean, var) over calibration batches.
+
+    The classic BN-recalibration pass: forward-only passes (no label use, no
+    updates) through a ``norm="batch"`` model in batch-stats mode, folding
+    each batch's per-site moments into an exponential moving average. Returns
+    the ``bn_ema`` collection pytree — statistics carried OUTSIDE params —
+    that ``ResNet50Config(bn_ema=True)`` models read at inference, restoring
+    the reference BatchNorm's eval behavior (accuracy independent of eval
+    batch size/composition). ``image_batches`` yields preprocessed image
+    arrays ``[B, H, W, C]``."""
+    if model.config.bn_ema:
+        raise ValueError("calibrate with a batch-stats model "
+                         "(ResNet50Config(bn_ema=False)); the EMA-reading "
+                         "model is for inference")
+
+    @jax.jit
+    def batch_stats(p, images):
+        _, muts = model.apply({"params": p}, images, mutable=["bn_stats"])
+        return muts["bn_stats"]
+
+    def to_ema(tree):
+        # sow() wraps each sown value in a 1-tuple under leaf key "batch";
+        # the bn_ema collection stores the same [2, C] stack under "stats".
+        if isinstance(tree, dict):
+            return {("stats" if k == "batch" else k): to_ema(v)
+                    for k, v in tree.items()}
+        return tree[0] if isinstance(tree, tuple) else tree
+
+    ema = None
+    for images in image_batches:
+        stats = to_ema(jax.device_get(batch_stats(params, images)))
+        if ema is None:
+            ema = stats
+        else:
+            ema = jax.tree_util.tree_map(
+                lambda e, s: momentum * e + (1.0 - momentum) * s, ema, stats)
+    if ema is None:
+        raise ValueError("calibrate_bn_ema needs at least one batch")
+    return ema
 
 
 def make_loss_fn(model: ResNet) -> Callable:

@@ -23,36 +23,63 @@ property the reference's protobuf-over-grpc plane had (its servers were
 unauthenticated but typed). The SPMD data plane is untouched — this is the
 host-side control/parameter plane that has no XLA equivalent.
 
-The bytes-on-the-wire hot path is native (``native/transport.cc``, built
-lazily like the data loader): one writev per message and a single-buffer
-receive, syscalls made with the GIL released — measured 1.9x the Python
-socket path at 8 MB gradient messages. The Python fallback speaks the same
-framing, so endpoints mix freely; sockets carrying a timeout always use the
-Python path to keep timeout semantics.
+The bytes-on-the-wire hot path is ZERO-COPY in both directions:
+
+- Send: ``wire.encode_parts`` frames ndarrays as borrowed views of their own
+  memory and ``_send_payload`` hands the scatter-gather list straight to
+  ``socket.sendmsg`` (one syscall, no ``tobytes()``/concat copies), with a
+  chunked ``sendall`` fallback where ``sendmsg`` is unavailable.
+- Receive: the payload lands in a per-connection recycled buffer
+  (``_RecvBuffer`` — reused only once every alias from the previous message
+  has been dropped, checked by refcount) and ``wire.decode(..., copy=False)``
+  aliases tensors into it, so the PSServer apply path and the client pull
+  path never copy tensor bytes on the host.
+
+Framing is 8 bytes big-endian ahead of the payload; the TOP byte is the
+frame VERSION (0 for this format — the payload length spans the low 56
+bits), so pre-zero-copy endpoints — whose lengths never reached 2^56 —
+interoperate bit-for-bit and a future incompatible framing is detectable
+instead of being misparsed as an absurd length. Sockets carrying a timeout
+always use the Python path to keep timeout semantics.
 """
 
 import os
 import socket
 import socketserver
 import struct
+import sys
 import threading
-from typing import Any, Optional, Tuple
+import time
+from typing import Any, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
 
 from autodist_tpu.parallel import wire
 from autodist_tpu.utils import logging
+from autodist_tpu.utils.metrics import WireCounters
 
 PyTree = Any
 
 _HDR = struct.Struct("!Q")
+# Top header byte = frame version; low 56 bits = payload length.
+_FRAME_VERSION = 0
+_FRAME_LEN_MAX = (1 << 56) - 1
+# sendmsg batches at most this many iovecs per syscall (safely under any
+# platform's IOV_MAX); longer part lists loop.
+_IOV_BATCH = 64
 
 # ---------------------------------------------------------------- native plane
-# The bytes-on-the-wire hot path compiles to native/transport.cc (writev send,
-# one-buffer recv, GIL released during the syscalls) — the reference's PS plane
-# was likewise native (TF's C++ grpc, SURVEY.md §2.4). The Python fallback
-# below speaks the identical framing, so mixed endpoints interoperate.
+# native/transport.cc (writev send, one-buffer recv, GIL released during the
+# syscalls) — the reference's PS plane was likewise native (TF's C++ grpc,
+# SURVEY.md §2.4). The zero-copy plane SUPERSEDED it on the production hot
+# paths (scatter-gather sendmsg sends, pooled recv_into receives — measured
+# faster in `bench.py --wire` because it removes the codec copies, which
+# dominated, not just the framing ones). The lib is retained as the
+# send/receive plane for external single-`bytes`-payload and pool-less
+# callers of _send_payload/_recv_msg, and the mixed-pairing tests keep both
+# planes byte-interoperable so old and new endpoints can coexist in one
+# cluster.
 _TR_LIB = None
 _TR_FAILED = False
 _TR_LOCK = threading.Lock()
@@ -99,32 +126,71 @@ def _native_error(lib, what: str) -> ConnectionError:
         f"PS transport {what} failed (errno {err}: {os.strerror(err)})")
 
 
-def _send_msg(sock: socket.socket, obj) -> int:
-    """Send one framed message; returns the payload byte count (for the
-    client's wire accounting)."""
-    return _send_payload(sock, wire.encode(obj))
+def _send_msg(sock: socket.socket, obj,
+              counters: Optional[WireCounters] = None) -> int:
+    """Send one framed message (scatter-gather encode, no serialization
+    copies); returns the payload byte count for the caller's accounting."""
+    t0 = time.perf_counter() if counters is not None else 0.0
+    parts = wire.encode_parts(obj)
+    enc_s = time.perf_counter() - t0 if counters is not None else 0.0
+    n = _send_payload(sock, parts)
+    if counters is not None:
+        counters.add_sent(n, enc_s)
+    return n
 
 
-def _send_payload(sock: socket.socket, payload: bytes) -> int:
-    """Send an already-encoded payload with framing (the server pre-encodes
-    replies so an encode failure can be reported instead of dropping the
-    connection)."""
-    # Native path only for plain blocking sockets: a socket timeout must keep
-    # Python's timeout semantics, which raw-fd syscalls would bypass.
+def _sendmsg_all(sock: socket.socket, buffers: List[Any]) -> None:
+    """sendall for a scatter-gather buffer list: one ``sendmsg`` syscall per
+    <= _IOV_BATCH parts, resuming mid-part after short writes."""
+    queue = [memoryview(b) for b in buffers if len(b)]
+    while queue:
+        sent = sock.sendmsg(queue[:_IOV_BATCH])
+        while queue and sent >= len(queue[0]):
+            sent -= len(queue[0])
+            queue.pop(0)
+        if sent and queue:
+            queue[0] = queue[0][sent:]
+
+
+def _send_payload(sock: socket.socket,
+                  payload: Union[bytes, bytearray, List[Any]]) -> int:
+    """Send an already-encoded payload — one buffer or an ``encode_parts``
+    list — with framing (the server pre-encodes replies so an encode failure
+    can be reported instead of dropping the connection)."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        parts, total = [payload], len(payload)
+    else:
+        parts = payload
+        total = sum(len(p) for p in parts)
+    if total > _FRAME_LEN_MAX:
+        raise wire.WireError(
+            f"message of {total} bytes exceeds the 56-bit frame length")
+    # Native path only for plain blocking sockets (a socket timeout must keep
+    # Python's timeout semantics, which raw-fd syscalls would bypass) and
+    # single contiguous bytes payloads (the ctypes surface takes one buffer;
+    # scatter-gather lists go through sendmsg below, which is its own
+    # single-syscall writev).
     lib = _native_transport() if sock.gettimeout() is None else None
-    if lib is not None:
+    if lib is not None and len(parts) == 1 and type(parts[0]) is bytes:
+        data = parts[0]
         while True:
-            rc = lib.tr_send(sock.fileno(), payload, len(payload))
+            rc = lib.tr_send(sock.fileno(), data, total)
             if rc == 0:
-                return len(payload)
+                return total
             if rc == -2:
                 # Signal before any byte moved: the ctypes-call boundary has
                 # run pending Python signal handlers (KeyboardInterrupt raises
                 # here); otherwise retry the send.
                 continue
             raise _native_error(lib, "send")
-    sock.sendall(_HDR.pack(len(payload)) + payload)
-    return len(payload)
+    header = _HDR.pack(total)  # top byte 0 == _FRAME_VERSION
+    if hasattr(sock, "sendmsg"):
+        _sendmsg_all(sock, [header, *parts])
+    else:  # very old/exotic platforms: chunked sendall, still no concat copy
+        sock.sendall(header)
+        for p in parts:
+            sock.sendall(p)
+    return total
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -137,8 +203,70 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket):
-    """Receive one framed message; returns ``(obj, payload_bytes)``."""
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise ConnectionError("PS transport connection closed")
+        got += r
+
+
+def _frame_len(header: bytes) -> int:
+    """Validate the 8-byte frame header; returns the payload length.
+    Raises :class:`wire.WireError` for an unknown frame version (the top
+    header byte) so the server treats it like any other malformed peer."""
+    (word,) = _HDR.unpack(header)
+    version = word >> 56
+    if version != _FRAME_VERSION:
+        raise wire.WireError(
+            f"unsupported PS frame version {version} (header {header!r})")
+    return word & _FRAME_LEN_MAX
+
+
+class _RecvBuffer:
+    """Per-connection recycled receive buffer for the zero-copy plane.
+
+    ``take(n)`` returns a writable view of an owned buffer. The buffer is
+    REUSED only when nothing else references it (``sys.getrefcount == 2``:
+    this object's slot + the refcount argument) — arrays aliased out of the
+    previous message by ``wire.decode(copy=False)`` hold references through
+    their ``.base`` chain, so a consumer that kept the tree (e.g. the
+    client's conditional-pull cache, or jax buffers still pinned by an
+    in-flight dispatch) silently gets a FRESH buffer instead of having its
+    data overwritten. Consume-then-drop callers pay zero copies; holders pay
+    one allocation, never corruption."""
+
+    __slots__ = ("_buf",)
+    _MIN_BYTES = 1 << 16
+
+    def __init__(self):
+        self._buf: Optional[bytearray] = None
+
+    def take(self, n: int) -> memoryview:
+        if (self._buf is None or len(self._buf) < n
+                or sys.getrefcount(self._buf) != 2):
+            self._buf = bytearray(max(n, self._MIN_BYTES))
+        return memoryview(self._buf)[:n]
+
+
+def _recv_msg(sock: socket.socket, pool: Optional[_RecvBuffer] = None,
+              counters: Optional[WireCounters] = None):
+    """Receive one framed message; returns ``(obj, payload_bytes)``.
+
+    With ``pool`` the payload is received straight into the pool's recycled
+    buffer and decoded with ``copy=False`` — tensors alias the buffer (see
+    :class:`_RecvBuffer` for the reuse contract). Without it, the payload is
+    decoded with copies (native single-buffer receive when available)."""
+    if pool is not None:
+        n = _frame_len(_recv_exact(sock, _HDR.size))
+        view = pool.take(n)
+        _recv_exact_into(sock, view)
+        t0 = time.perf_counter() if counters is not None else 0.0
+        obj = wire.decode(view, copy=False)
+        if counters is not None:
+            counters.add_received(n, time.perf_counter() - t0)
+        return obj, n
     lib = _native_transport() if sock.gettimeout() is None else None
     if lib is not None:
         import ctypes
@@ -153,11 +281,17 @@ def _recv_msg(sock: socket.socket):
             # Zero-copy view over the malloc'd buffer; wire.decode copies
             # tensor data out, so freeing right after is safe.
             view = memoryview((ctypes.c_char * n).from_address(out.value or 0))
-            return wire.decode(view), n
+            obj = wire.decode(view)
         finally:
             lib.tr_free(out)
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return wire.decode(_recv_exact(sock, n)), n
+        if counters is not None:
+            counters.add_received(n)
+        return obj, n
+    n = _frame_len(_recv_exact(sock, _HDR.size))
+    obj = wire.decode(_recv_exact(sock, n))
+    if counters is not None:
+        counters.add_received(n)
+    return obj, n
 
 
 def _to_host(tree: PyTree) -> PyTree:
@@ -181,6 +315,10 @@ class PSServer:
         if runner.service is None:
             raise RuntimeError("Call runner.init(params) before serving")
         self._runner = runner
+        # Aggregate wire accounting across every connection this server has
+        # handled (payload bytes, message counts, encode/decode time) —
+        # surfaced in the async-PS log line and summarized at close().
+        self.wire = WireCounters()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -194,14 +332,21 @@ class PSServer:
                 self.worker_id = None
                 self.worker_gen = 0
                 controller = outer._runner.controller
+                # Zero-copy receive plane: requests land in this connection's
+                # recycled buffer; decoded tensors (the apply path's gradient
+                # trees) alias it and are consumed by the dispatch before the
+                # next recv can touch the buffer.
+                pool = _RecvBuffer()
                 try:
                     while True:
-                        msg, _ = _recv_msg(self.request)
+                        msg, _ = _recv_msg(self.request, pool=pool,
+                                           counters=outer.wire)
                         reply = outer._dispatch(msg)
                         is_protocol = isinstance(msg, tuple) and bool(msg)
                         op = msg[0] if is_protocol else "<malformed>"
+                        t0 = time.perf_counter()
                         try:
-                            payload = wire.encode(reply)
+                            payload = wire.encode_parts(reply)
                         except wire.WireError as e:
                             # OUR reply is unencodable (e.g. the user's params
                             # tree contains an unregistered pytree node) —
@@ -210,10 +355,11 @@ class PSServer:
                             logging.warning(
                                 "PS transport: reply to %r is not "
                                 "wire-encodable (%s)", op, e)
-                            payload = wire.encode((
+                            payload = wire.encode_parts((
                                 "error", "WireError",
                                 f"server reply to {op!r} is not "
                                 f"wire-encodable: {e}"))
+                        enc_s = time.perf_counter() - t0
                         # The generation token rides in the dispatch reply,
                         # read inside the controller's own critical section —
                         # a separate generation() read here could race a
@@ -239,7 +385,13 @@ class PSServer:
                             # allocations, whose id only the reply knows).
                             self.worker_id = reply[1]
                             self.worker_gen = reply[2]
-                        _send_payload(self.request, payload)
+                        outer.wire.add_sent(_send_payload(self.request,
+                                                          payload), enc_s)
+                        # Drop this message's decoded tree (it aliases the
+                        # recv buffer) BEFORE the next recv, or the loop
+                        # variable itself would pin the buffer and defeat
+                        # recycling for every message.
+                        msg = reply = payload = None
                 except wire.WireError as e:
                     # Malformed/out-of-vocabulary bytes (a broken or hostile
                     # peer): drop the connection. Decoding allocates data only
@@ -305,6 +457,21 @@ class PSServer:
                 if params is None:  # not modified: version-only reply, no tree
                     return ("ok", None, None, version)
                 return ("ok", _to_host(params), _to_host(ef_state), version)
+            if op == "read_min":
+                # Overlapped-client prefetch: wait (bounded) until the service
+                # reaches min_version — normally the caller's own in-flight
+                # apply on its other connection — then conditional-read. The
+                # wait runs on this connection's own handler thread, so it
+                # stalls nobody else (the same property the start_step gate
+                # relies on). The timeout is clamped: a hostile peer must not
+                # park threads indefinitely.
+                _, min_version, have_version, timeout = msg
+                timeout = min(float(timeout), 600.0) if timeout else 0.0
+                params, ef_state, version = r.service.read_min(
+                    min_version, have_version, timeout)
+                if params is None:
+                    return ("ok", None, None, version)
+                return ("ok", _to_host(params), _to_host(ef_state), version)
             if op == "apply":
                 version = r.service.apply(msg[1])
                 return ("ok", version)
@@ -327,6 +494,8 @@ class PSServer:
     def close(self):
         self._server.shutdown()
         self._server.server_close()
+        if self.wire.msgs_received:
+            logging.info("PSServer closed: %s", self.wire.format_line())
 
 
 class PSClientError(RuntimeError):
@@ -352,16 +521,33 @@ class _PSClient:
                 time.sleep(0.2)
         self._sock.settimeout(None)
         self._lock = threading.Lock()
-        # Wire accounting (payload bytes, both directions) — lets callers and
-        # tests measure what a protocol change (e.g. read_if_newer) saves.
-        self.bytes_sent = 0
-        self.bytes_received = 0
+        self._pool = _RecvBuffer()
+        # Wire accounting (payload bytes/messages both directions + codec
+        # time) — lets callers and tests measure what a protocol change
+        # (e.g. read_if_newer) saves.
+        self.wire = WireCounters()
+
+    @property
+    def bytes_sent(self) -> int:
+        return self.wire.bytes_sent
+
+    @property
+    def bytes_received(self) -> int:
+        return self.wire.bytes_received
+
+    def call_raw(self, msg: tuple, counters: WireCounters):
+        """One request/reply exchange accounted into ``counters`` (NOT this
+        client's own) and returned unchecked — the overlapped prefetch path,
+        whose bytes are attributed only when the result is consumed so
+        ``wire_bytes`` reads stay deterministic while a pull is in flight."""
+        with self._lock:
+            _send_msg(self._sock, msg, counters)
+            reply, _ = _recv_msg(self._sock, pool=self._pool,
+                                 counters=counters)
+        return reply
 
     def call(self, *msg):
-        with self._lock:
-            self.bytes_sent += _send_msg(self._sock, msg)
-            reply, nbytes = _recv_msg(self._sock)
-            self.bytes_received += nbytes
+        reply = self.call_raw(msg, self.wire)
         if reply[0] != "ok":
             # Re-raise gate timeouts under their real type so callers written
             # against the AsyncWorker contract (`except StalenessTimeout`) keep
@@ -374,7 +560,26 @@ class _PSClient:
         return reply[1:]
 
     def close(self):
+        # shutdown() before close(): closing an fd does NOT wake a thread
+        # blocked inside recv(2) on Linux — the overlapped worker's prefetch
+        # thread may be parked exactly there, and it must observe EOF at
+        # close time, not after the server-side read_min wait expires.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # never connected / peer already gone
         self._sock.close()
+
+
+class _Prefetch:
+    """An in-flight overlapped parameter pull (result, error, accounting)."""
+    __slots__ = ("thread", "result", "error", "counters")
+
+    def __init__(self):
+        self.thread = None
+        self.result = None
+        self.error = None
+        self.counters = WireCounters()
 
 
 class RemotePSWorker:
@@ -383,14 +588,39 @@ class RemotePSWorker:
     Mirrors :class:`~autodist_tpu.parallel.staleness.AsyncWorker` but with the
     service/controller calls crossing the transport; gradient computation runs on
     this process's own devices through the runner's jitted grad fn.
-    """
 
-    def __init__(self, address, runner, worker_id: int):
+    The client is OVERLAPPED by default (``overlap=None`` reads
+    ``AUTODIST_PS_OVERLAP``, default on): a second connection carries a
+    background parameter pull that is kicked off just before step k's
+    gradient push, using the ``read_min`` op so the server replies once the
+    worker's own apply has landed — the step-k+1 download streams while the
+    step-k upload, ``finish_step``/``start_step`` round trips, and batch
+    sharding proceed, hiding one RTT plus a full parameter transfer per step.
+    The staleness gate is untouched (``finish_step`` is still sent only after
+    the apply is acknowledged), and the prefetched tree is used only after a
+    post-gate revalidation (``read_if_newer``) confirms it is the CURRENT
+    version — if other workers applied in between, the client re-pulls, so
+    every value and version a step observes is identical to the serial
+    client's. Old servers without ``read_min`` degrade gracefully (the
+    prefetch falls back to a plain conditional read)."""
+
+    # Bound on the server-side read_min wait and on joining a prefetch; a
+    # wedged pull connection disables overlap rather than wedging the step.
+    PREFETCH_TIMEOUT = 30.0
+
+    def __init__(self, address, runner, worker_id: int,
+                 overlap: Optional[bool] = None):
         self._client = _PSClient(address)
         self._runner = runner
         self.worker_id = worker_id
         self.steps_completed = 0
         self.last_version_read = -1
+        if overlap is None:
+            from autodist_tpu import const
+            overlap = const.ENV.AUTODIST_PS_OVERLAP.val
+        self._pull_client = _PSClient(address) if overlap else None
+        self._prefetch: Optional[_Prefetch] = None
+        self._server_has_read_min = True  # optimistic; cleared on unknown-op
         # Register up front: idempotent for a live slot (the server keeps its
         # count), and for a RETIRED slot — e.g. a Coordinator-relaunched worker
         # reusing its AUTODIST_PROCESS_ID — it re-admits the slot so stepping
@@ -407,8 +637,17 @@ class RemotePSWorker:
 
     @property
     def wire_bytes(self) -> Tuple[int, int]:
-        """(sent, received) payload bytes over this worker's transport."""
+        """(sent, received) payload bytes over this worker's transport.
+
+        Deterministic under overlap: a background pull's bytes are attributed
+        when its result is CONSUMED (the next step's pull), not while it
+        streams, so two reads bracketing a step measure exactly that step."""
         return self._client.bytes_sent, self._client.bytes_received
+
+    @property
+    def wire_counters(self) -> WireCounters:
+        """Full wire accounting (bytes/messages/codec time), consumed-basis."""
+        return self._client.wire
 
     def register(self) -> int:
         """(Re-)admit this worker to the chief's staleness gate — the elastic
@@ -429,9 +668,87 @@ class RemotePSWorker:
         with self._runner.mesh:
             jax.block_until_ready(self._runner.grad_fn(params, sharded, ef_state)[0])
 
+    def _start_prefetch(self):
+        """Kick the step-k+1 parameter pull onto the second connection, just
+        before step k's gradient push: ``read_min(last+1)`` parks on the
+        server until the in-flight apply lands, then streams the new tree
+        while this thread pushes/finishes/gates. Bytes are accounted at join
+        (:meth:`wire_bytes`)."""
+        if self._pull_client is None or self._prefetch is not None:
+            return
+        pf = _Prefetch()
+        have = self.last_version_read
+        use_read_min = self._server_has_read_min
+        client = self._pull_client
+
+        def run():
+            try:
+                if use_read_min:
+                    reply = client.call_raw(
+                        ("read_min", have + 1, have, self.PREFETCH_TIMEOUT),
+                        pf.counters)
+                    if (reply[0] == "error" and len(reply) > 2
+                            and "unknown op" in str(reply[2])):
+                        # Pre-read_min server: degrade to a plain conditional
+                        # read for this and every later prefetch. ONLY the
+                        # unknown-op reply downgrades — any other server-side
+                        # error is transient (this prefetch is simply
+                        # discarded at join) and must not cost the overlap
+                        # for the worker's whole life.
+                        self._server_has_read_min = False
+                        logging.info(
+                            "PS overlap: server has no read_min op; "
+                            "prefetching with plain conditional reads")
+                        reply = client.call_raw(("read_if_newer", have),
+                                                pf.counters)
+                else:
+                    reply = client.call_raw(("read_if_newer", have),
+                                            pf.counters)
+                pf.result = reply
+            except BaseException as e:  # surfaced (or discarded) at join
+                pf.error = e
+        pf.thread = threading.Thread(target=run, daemon=True,
+                                     name="ps-pull-prefetch")
+        pf.thread.start()
+        self._prefetch = pf
+
+    def _take_prefetch(self):
+        """Join the in-flight pull; returns ``(params, ef_state, version)`` or
+        ``None``. A failed/wedged pull connection disables overlap for the
+        rest of this worker's life — the serial path is always correct."""
+        pf, self._prefetch = self._prefetch, None
+        if pf is None:
+            return None
+        pf.thread.join(timeout=self.PREFETCH_TIMEOUT + 30.0)
+        if pf.thread.is_alive() or pf.error is not None:
+            logging.warning(
+                "PS overlap: background pull failed (%s); falling back to "
+                "serial pulls", pf.error or "join timeout")
+            if self._pull_client is not None:
+                try:
+                    self._pull_client.close()
+                except OSError:
+                    pass
+                self._pull_client = None
+            return None
+        # Consumed now: fold the pull's bytes into the visible accounting.
+        self._client.wire.merge(pf.counters)
+        if pf.result[0] != "ok":
+            return None
+        return pf.result[1:]
+
     def _pull(self):
         """Current (params, ef_state, version), skipping the parameter payload
-        when the service hasn't advanced past the cached version."""
+        when the service hasn't advanced past the cached version. A completed
+        background pull pre-seeds the cache; the conditional read below then
+        REVALIDATES it against the live version, so the returned tree is
+        byte-identical to what a serial pull at this moment would see."""
+        pf = self._take_prefetch()
+        if pf is not None:
+            p_params, p_ef, p_version = pf
+            if p_params is not None and p_version > self.last_version_read:
+                self._cached_pull = (p_params, p_ef)
+                self.last_version_read = p_version
         if self._cached_pull is None:
             params, ef_state, version = self._client.call("read")
         else:
@@ -450,7 +767,13 @@ class RemotePSWorker:
         sharded = r.shard_batch(batch)
         with r.mesh:
             grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
-        self._client.call("apply", _to_host(grads))
+        grads = _to_host(grads)
+        # Overlap: next step's parameter download streams on the second
+        # socket while this one pushes the gradients and runs the
+        # finish/start gate round trips. The gate ordering is unchanged —
+        # finish_step goes out only after the apply is acknowledged.
+        self._start_prefetch()
+        self._client.call("apply", grads)
         self._client.call("finish_step", self.worker_id)
         self.steps_completed += 1
         if r.has_aux:
@@ -462,4 +785,11 @@ class RemotePSWorker:
         return self._client.call("version")[0]
 
     def close(self):
+        pf, self._prefetch = self._prefetch, None
+        if self._pull_client is not None:
+            # Closing the socket unblocks an in-flight background pull.
+            self._pull_client.close()
+            self._pull_client = None
+        if pf is not None and pf.thread is not None:
+            pf.thread.join(timeout=5.0)
         self._client.close()

@@ -205,7 +205,17 @@ class ParameterService:
     def __init__(self, state: TrainState, apply_fn):
         self._state = state
         self._apply_fn = apply_fn
-        self._lock = threading.Lock()
+        # A Condition, not a bare Lock: read_min (the overlapped transport
+        # client's prefetch) waits on version advancement; every state
+        # replacement notifies. `with self._lock:` works unchanged.
+        self._lock = threading.Condition()
+        # Serializes WRITERS (apply/reset/adopt) separately from the snapshot
+        # Condition above: the gradient application's device execution runs
+        # under only this mutex, so readers (read/read_if_newer/read_min —
+        # the transport's pull hot path) block for the brief state swap, not
+        # for a whole apply program. Order: _write_mutex -> _lock, never the
+        # reverse.
+        self._write_mutex = threading.Lock()
         # Generation counter: bumps on EVERY state replacement (apply, reset,
         # adopt) and is never reused, so version equality implies state
         # identity — the contract read_if_newer's "not modified" answer (and
@@ -217,10 +227,12 @@ class ParameterService:
     def reset(self, state: TrainState):
         """Replace the state (checkpoint restore). The update count restarts;
         the version keeps counting so stale cached pulls can never alias."""
-        with self._lock:
-            self._state = state
-            self._version += 1
-            self._updates = 0
+        with self._write_mutex:
+            with self._lock:
+                self._state = state
+                self._version += 1
+                self._updates = 0
+                self._lock.notify_all()
 
     @property
     def version(self) -> int:
@@ -251,13 +263,38 @@ class ParameterService:
                 return None, None, self._version
             return self._state.params, self._state.ef_state, self._version
 
-    def apply(self, grads: PyTree) -> int:
-        """Apply one worker's gradients; returns the new version."""
+    def read_min(self, min_version: int, have_version: int,
+                 timeout: Optional[float] = None):
+        """:meth:`read_if_newer` that first waits (up to ``timeout`` seconds)
+        for the service to reach ``min_version``. The overlapped PS client
+        prefetches with ``min_version = last_read + 1`` just before pushing
+        its gradients: the reply is released the moment its own apply lands,
+        so the parameter download overlaps the push and the gate round-trips
+        instead of following them. On timeout the CURRENT state is returned
+        (never an error) — the client revalidates against the live version
+        anyway, so a missed floor only costs the overlap, not correctness."""
         with self._lock:
-            self._state = self._apply_fn(self._state, grads)
-            self._version += 1
-            self._updates += 1
-            return self._version
+            self._lock.wait_for(lambda: self._version >= min_version, timeout)
+            if self._version == have_version:
+                return None, None, self._version
+            return self._state.params, self._state.ef_state, self._version
+
+    def apply(self, grads: PyTree) -> int:
+        """Apply one worker's gradients; returns the new version.
+
+        The device execution runs under the writer mutex only — we are the
+        sole state replacer while holding it, so reading ``self._state``
+        without the snapshot lock is safe, and concurrent readers keep
+        snapshotting the pre-apply state (exactly what they would have seen
+        mid-apply anyway) instead of stalling behind a whole apply program."""
+        with self._write_mutex:
+            new_state = self._apply_fn(self._state, grads)
+            with self._lock:
+                self._state = new_state
+                self._version += 1
+                self._updates += 1
+                self._lock.notify_all()
+                return self._version
 
     @property
     def updates_applied(self) -> int:
@@ -266,9 +303,9 @@ class ParameterService:
     def adopt(self, state: TrainState, place_fn) -> None:
         """Atomically adopt a foreign state iff no updates have been applied yet
         (the checkpoint-restore pattern). The identity check, version check, and
-        replacement happen under one lock hold so a concurrently stepping worker
-        cannot slip an ``apply`` between check and reset."""
-        with self._lock:
+        replacement happen under the writer mutex so a concurrently stepping
+        worker cannot slip an ``apply`` between check and reset."""
+        with self._write_mutex:
             if state is self._state:
                 return
             if self._updates != 0:
@@ -276,8 +313,11 @@ class ParameterService:
                     "AsyncPSRunner.run was handed a state that is not the service's "
                     "current state after updates were already applied; use "
                     "restore(state) to adopt a checkpoint explicitly")
-            self._state = place_fn(state)
-            self._version += 1  # new generation: cached pulls must refetch
+            placed = place_fn(state)
+            with self._lock:
+                self._state = placed
+                self._version += 1  # new generation: cached pulls must refetch
+                self._lock.notify_all()
 
 
 class AsyncWorker:
@@ -300,7 +340,12 @@ class AsyncWorker:
         sharded = r.shard_batch(batch)
         r._maybe_dump_async_graphs(params, sharded, ef_state)
         with r.mesh:
-            grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
+            # Gradient programs carry cross-replica collectives: run one at a
+            # time to completion (see _collective_lock) so two workers' steps
+            # can never interleave a rendezvous.
+            with r._collective_lock:
+                grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
+                jax.block_until_ready((grads, loss, aux, _ef))
             r.service.apply(grads)
         r.controller.finish_step(self.worker_id)
         self.steps_completed += 1
@@ -357,6 +402,16 @@ class AsyncPSRunner(DistributedRunner):
         self._jit_grad_fn = jax.jit(self._grad_fn)
         self._workers = {i: AsyncWorker(self, i) for i in range(self.num_workers)}
         self._membership_lock = threading.Lock()  # add_worker bookkeeping
+        # Serializes multi-device program EXECUTION (dispatch + completion)
+        # across this process's threads: two concurrently executing programs
+        # that both carry cross-replica collectives can interleave their
+        # rendezvous on the shared device pool and deadlock (observed on the
+        # CPU backend when host threads < participants: each program's
+        # all-reduce waits forever for participants the other program's
+        # execution is holding). In-process async workers time-share one mesh
+        # anyway — real concurrency lives across processes, whose devices are
+        # disjoint — so the serialization costs ordering, not parallelism.
+        self._collective_lock = threading.Lock()
         self._dump_lock = threading.Lock()
         self._dumped = False
         self._placer = None
@@ -422,10 +477,27 @@ class AsyncPSRunner(DistributedRunner):
                           plan=state.plan)
 
     def _locked_apply(self, apply_fn):
+        # Execution serialized like the workers' gradient programs: the PS
+        # apply is itself a multi-device program, and its (asynchronously
+        # executing) collectives must not interleave with a concurrently
+        # dispatched gradient program's (see _collective_lock).
         def run(state, grads):
             with self.mesh:
-                return apply_fn(state, grads)
+                with self._collective_lock:
+                    new_state = apply_fn(state, grads)
+                    jax.block_until_ready(new_state)
+                    return new_state
         return run
+
+    def wire_stats(self):
+        """Transport wire counters for the async-PS log line — the worker's
+        client-side accounting, or the chief's server-side aggregate; ``None``
+        when this runner is not on the transport at all."""
+        if self._remote_worker is not None:
+            return self._remote_worker.wire_counters
+        if self._ps_server is not None:
+            return self._ps_server.wire
+        return None
 
     def close(self):
         """Release transport endpoints (chief's server / worker's client). Called

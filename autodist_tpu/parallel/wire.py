@@ -17,8 +17,18 @@ format covering exactly the protocol's value vocabulary —
 
 Decoding allocates plain Python/numpy objects; there is no reduce protocol,
 no module import, no callable evaluation. Unknown tags or registry keys
-raise :class:`WireError`. Arrays are copied out of the input buffer so the
-caller may free it (the native receive path does).
+raise :class:`WireError`. By default arrays are copied out of the input
+buffer so the caller may free it; ``decode(buf, copy=False)`` instead
+aliases array payloads into ``buf`` (read-only views) for receive paths
+that keep the buffer alive — see :func:`decode`.
+
+The encoder has two faces over one code path: :func:`encode` returns one
+``bytes`` object, and :func:`encode_parts` returns a scatter-gather list of
+buffers whose concatenation is byte-identical to ``encode``'s output — large
+C-contiguous ndarrays ride as BORROWED views of their own memory (no
+``tobytes()`` copy, no concat copy), so a multi-MB gradient push serializes
+without touching the tensor bytes. Old and new endpoints therefore
+interoperate freely: the bytes on the wire are the same either way.
 
 Ints use a fixed 8-byte signed encoding with a decimal-string escape for
 arbitrary precision; dict keys may be any encodable value (the protocol uses
@@ -26,11 +36,12 @@ str keys, but pytrees may legally carry int keys).
 """
 
 import struct
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, List, Tuple
 
 import numpy as np
 
-__all__ = ["encode", "decode", "register_wire_dataclass", "WireError"]
+__all__ = ["encode", "encode_parts", "decode", "register_wire_dataclass",
+           "WireError"]
 
 
 class WireError(ValueError):
@@ -65,13 +76,54 @@ def register_wire_dataclass(cls: type, key: str = None) -> type:
 
 # ---------------------------------------------------------------------- encode
 
-def _enc_str(out: bytearray, s: str):
+# Arrays at or above this many bytes are emitted as borrowed buffers by
+# encode_parts; smaller ones are inlined into the adjacent header segment
+# (a dedicated iovec per 8-byte scalar would cost more than the copy saves).
+_BORROW_MIN_BYTES = 1024
+
+
+class _PartSink:
+    """bytearray-compatible accumulator that can split out borrowed buffers.
+
+    ``_enc`` only ever does ``out += <bytes-like>``, so the same encoder body
+    serves both faces: with a plain ``bytearray`` it produces one contiguous
+    message (:func:`encode`); with a ``_PartSink`` large array payloads are
+    appended as zero-copy views between the accumulated header segments
+    (:func:`encode_parts`)."""
+
+    __slots__ = ("parts", "tail")
+
+    def __init__(self):
+        self.parts: List[Any] = []
+        self.tail = bytearray()
+
+    def __iadd__(self, data):
+        self.tail += data
+        return self
+
+    def borrow(self, view):
+        """Append ``view`` (a memoryview over caller-owned memory) without
+        copying; the caller must keep the backing memory unchanged until the
+        parts have been sent."""
+        if self.tail:
+            self.parts.append(self.tail)
+            self.tail = bytearray()
+        self.parts.append(view)
+
+    def finish(self) -> List[Any]:
+        if self.tail:
+            self.parts.append(self.tail)
+            self.tail = bytearray()
+        return self.parts
+
+
+def _enc_str(out, s: str):
     b = s.encode("utf-8")
     out += _u32.pack(len(b))
     out += b
 
 
-def _enc(out: bytearray, obj: Any):
+def _enc(out, obj: Any):
     if obj is None:
         out += b"N"
     elif obj is True:
@@ -111,9 +163,18 @@ def _enc(out: bytearray, obj: Any):
         out += bytes([arr.ndim])
         for d in arr.shape:
             out += _u64.pack(d)
-        raw = arr.tobytes()  # raw C-order buffer; works for custom dtypes too
-        out += _u64.pack(len(raw))
-        out += raw
+        if (type(out) is _PartSink and arr.nbytes >= _BORROW_MIN_BYTES
+                and arr.flags.c_contiguous):
+            # Zero-copy: the payload is the array's own memory. A C-contiguous
+            # buffer viewed as flat uint8 is exactly tobytes()'s C-order
+            # output, so the concatenated parts stay byte-identical to
+            # encode(). (reshape(-1)/view are views here, never copies.)
+            out += _u64.pack(arr.nbytes)
+            out.borrow(memoryview(arr.reshape(-1).view(np.uint8)))
+        else:
+            raw = arr.tobytes()  # C-order buffer; works for custom dtypes too
+            out += _u64.pack(len(raw))
+            out += raw
     elif type(obj) is tuple:
         out += b"t"
         out += _u32.pack(len(obj))
@@ -153,14 +214,30 @@ def encode(obj: Any) -> bytes:
     return bytes(out)
 
 
+def encode_parts(obj: Any) -> List[Any]:
+    """Serialize a protocol message as a scatter-gather buffer list.
+
+    ``b"".join(encode_parts(obj)) == encode(obj)`` always holds — the parts
+    are the SAME wire bytes, merely not concatenated. Large C-contiguous
+    ndarray payloads come back as borrowed read-views of the arrays' own
+    memory, so the caller (``ps_transport._send_payload``) can hand the list
+    to ``socket.sendmsg`` and ship a multi-MB pytree with zero serialization
+    copies. The views borrow: do not mutate the source arrays until the
+    parts have been fully sent."""
+    sink = _PartSink()
+    _enc(sink, obj)
+    return sink.finish()
+
+
 # ---------------------------------------------------------------------- decode
 
 class _Reader:
-    __slots__ = ("buf", "pos")
+    __slots__ = ("buf", "pos", "copy")
 
-    def __init__(self, buf):
+    def __init__(self, buf, copy: bool = True):
         self.buf = memoryview(buf)
         self.pos = 0
+        self.copy = copy
 
     def take(self, n: int) -> memoryview:
         if self.pos + n > len(self.buf):
@@ -226,8 +303,15 @@ def _dec(r: _Reader) -> Any:
         want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
         if nbytes != want:
             raise WireError(f"array payload {nbytes}B != shape/dtype {want}B")
-        # Copy: the caller may free the receive buffer after decode.
-        flat = np.frombuffer(r.take(nbytes), np.uint8).copy()
+        flat = np.frombuffer(r.take(nbytes), np.uint8)
+        if r.copy:
+            # Copy: the caller may free the receive buffer after decode.
+            flat = flat.copy()
+        else:
+            # Alias: the array keeps the receive buffer alive through its
+            # .base chain; mark it read-only so a caller mutating a pulled
+            # tree cannot scribble over a recycled buffer.
+            flat.flags.writeable = False
         return flat.view(dtype).reshape(shape)
     if tag == b"t":
         return tuple(_dec(r) for _ in range(r.u32()))
@@ -257,16 +341,24 @@ def _dec(r: _Reader) -> Any:
     raise WireError(f"unknown wire tag {tag!r}")
 
 
-def decode(buf) -> Any:
-    """Deserialize one message (bytes/memoryview). Copies array data out of
-    ``buf``; the caller may free the buffer afterwards.
+def decode(buf, copy: bool = True) -> Any:
+    """Deserialize one message (bytes/memoryview).
+
+    ``copy=True`` (default): array data is copied out of ``buf``; the caller
+    may free/reuse the buffer afterwards. ``copy=False``: arrays come back as
+    READ-ONLY views aliasing ``buf`` — zero decode copies. The views keep the
+    buffer alive (refcount), but a transport recycling the buffer (see
+    ``ps_transport._RecvBuffer``) will overwrite it once every alias has been
+    dropped, so only callers that consume the tree — e.g. feed it to a jitted
+    function and drop it — before releasing their references should pass
+    ``copy=False``.
 
     EVERY malformed-input failure surfaces as :class:`WireError` — including
     bad UTF-8, overflowing dims, unhashable dict keys, wrong dataclass
     fields, or absurd nesting — so a server can catch one exception type and
     treat it as 'broken peer' (anything else escaping decode is a server-side
     bug, not bad input)."""
-    r = _Reader(buf)
+    r = _Reader(buf, copy=copy)
     try:
         obj = _dec(r)
     except WireError:

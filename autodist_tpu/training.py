@@ -169,8 +169,14 @@ def train(runner, params: PyTree,
             # local steps.
             rate = meter.step(sync=loss)
             if rate is not None:
-                logging.info("train: step %d loss %.4f %.1f examples/s",
-                             step_i + 1, float(loss), rate)
+                # Async-PS runs append their transport accounting (zero-copy
+                # wire counters) so per-period logs show parameter/gradient
+                # traffic next to throughput.
+                stats = getattr(runner, "wire_stats", None)
+                stats = stats() if callable(stats) else None
+                logging.info("train: step %d loss %.4f %.1f examples/s%s",
+                             step_i + 1, float(loss), rate,
+                             f" | {stats.format_line()}" if stats else "")
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
         if (eval_every and (step_i + 1) % eval_every == 0

@@ -8,10 +8,68 @@ here (the reference kept them in examples) so every example/benchmark shares one
 implementation.
 """
 
+import threading
 import time
 from typing import List, Optional
 
 from autodist_tpu.utils import logging
+
+
+class WireCounters:
+    """Per-connection PS-transport accounting: payload bytes and message
+    counts in both directions plus cumulative encode/decode seconds.
+
+    The transport's counterpart of the reference's grpc channel stats: one
+    instance per socket (client side) or aggregated across connections
+    (server side — increments are locked so concurrent handler threads
+    cannot lose counts). ``format_line()`` is the compact rendering the
+    async-PS log line carries."""
+
+    __slots__ = ("bytes_sent", "bytes_received", "msgs_sent", "msgs_received",
+                 "encode_s", "decode_s", "_lock")
+
+    def __init__(self):
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.msgs_sent = 0
+        self.msgs_received = 0
+        self.encode_s = 0.0
+        self.decode_s = 0.0
+        self._lock = threading.Lock()
+
+    def add_sent(self, nbytes: int, encode_s: float = 0.0):
+        with self._lock:
+            self.bytes_sent += nbytes
+            self.msgs_sent += 1
+            self.encode_s += encode_s
+
+    def add_received(self, nbytes: int, decode_s: float = 0.0):
+        with self._lock:
+            self.bytes_received += nbytes
+            self.msgs_received += 1
+            self.decode_s += decode_s
+
+    def merge(self, other: "WireCounters"):
+        """Fold another counter set into this one (prefetch-join accounting:
+        bytes pulled by a background prefetch are attributed when consumed,
+        keeping ``wire_bytes`` reads deterministic)."""
+        with self._lock:
+            self.bytes_sent += other.bytes_sent
+            self.bytes_received += other.bytes_received
+            self.msgs_sent += other.msgs_sent
+            self.msgs_received += other.msgs_received
+            self.encode_s += other.encode_s
+            self.decode_s += other.decode_s
+
+    def format_line(self) -> str:
+        """``wire tx 12.3MB/45 rx 67.8MB/46 enc 1.2ms/msg dec 3.4ms/msg``."""
+        def mb(n):
+            return f"{n / 1e6:.1f}MB"
+        enc = 1e3 * self.encode_s / max(self.msgs_sent, 1)
+        dec = 1e3 * self.decode_s / max(self.msgs_received, 1)
+        return (f"wire tx {mb(self.bytes_sent)}/{self.msgs_sent} "
+                f"rx {mb(self.bytes_received)}/{self.msgs_received} "
+                f"enc {enc:.2f}ms/msg dec {dec:.2f}ms/msg")
 
 
 def _sync(value) -> None:

@@ -27,6 +27,130 @@ def _baseline_path():
                         "PERF_BASELINE.json")
 
 
+def legacy_wire_send(sock, obj):
+    """The pre-zero-copy transport send, verbatim: full encode to one bytes
+    object, header CONCAT, one sendall. The reference implementation of
+    'legacy framing' shared by :func:`wire_bench` and the interop tests
+    (tests/test_codec_wire.py) so both always pin the same definition."""
+    import struct
+
+    from autodist_tpu.parallel import wire
+    payload = wire.encode(obj)
+    sock.sendall(struct.Struct("!Q").pack(len(payload)) + payload)
+
+
+def legacy_wire_recv(sock):
+    """The pre-zero-copy transport receive, verbatim: chunked accumulate into
+    a bytearray, full-copy decode."""
+    import struct
+
+    from autodist_tpu.parallel import wire
+    hdr = struct.Struct("!Q")
+
+    def read_exact(n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    (n,) = hdr.unpack(read_exact(hdr.size))
+    return wire.decode(read_exact(n))
+
+
+def wire_bench(payload_mib: int = 40, rounds: int = 4):
+    """PS-transport codec/framing micro-bench: round-trip a dense >=32 MiB
+    parameter-style pytree over a loopback socketpair through (a) the legacy
+    copying path — ``wire.encode`` + header-concat ``sendall`` + chunked
+    accumulate receive + ``wire.decode(copy=True)`` — and (b) the zero-copy
+    path the transport now ships: ``encode_parts`` borrowed buffers over
+    ``sendmsg``, ``recv_into`` a recycled buffer, alias decode. Prints ONE
+    JSON line with both throughputs and the speedup, diffed against the
+    recorded ``ps_wire`` row in PERF_BASELINE.json. Pure host/CPU work (no
+    accelerator): it isolates exactly the wire cost the async-PS data plane
+    pays per step."""
+    import socket
+    import sys
+    import threading
+
+    from autodist_tpu.parallel import ps_transport as tp
+    from autodist_tpu.parallel import wire
+
+    rng = np.random.RandomState(0)
+    n_layers = max(1, payload_mib // 4)
+    tree = ("ok", {f"layer{i}": {"w": rng.randn(1024, 1024).astype(np.float32),
+                                 "b": rng.randn(1024).astype(np.float32)}
+                   for i in range(n_layers)}, None, 7)
+    tree_bytes = sum(a.nbytes for lyr in tree[1].values() for a in lyr.values())
+
+    legacy_send, legacy_recv = legacy_wire_send, legacy_wire_recv
+
+    def zc_send(sock, obj):
+        tp._send_payload(sock, wire.encode_parts(obj))
+
+    def make_zc_recv():
+        pool = tp._RecvBuffer()
+        return lambda sock: tp._recv_msg(sock, pool=pool)[0]
+
+    def measure(send_fn, recv_fn_factory):
+        a, b = socket.socketpair()
+        stop = []
+
+        def echo():  # decode + re-encode each message, like a real endpoint
+            recv_fn = recv_fn_factory()
+            try:
+                while not stop:
+                    send_fn(b, recv_fn(b))
+            except (ConnectionError, OSError):
+                pass
+
+        t = threading.Thread(target=echo, daemon=True)
+        t.start()
+        recv_fn = recv_fn_factory()
+        try:
+            send_fn(a, tree)   # warmup round-trip
+            recv_fn(a)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                send_fn(a, tree)
+                recv_fn(a)
+            dt = time.perf_counter() - t0
+        finally:
+            stop.append(True)
+            a.close()
+            b.close()
+        # Payload bytes crossing the wire per round trip: out + back.
+        return 2 * tree_bytes * rounds / dt / 1e6
+
+    legacy = measure(legacy_send, lambda: legacy_recv)
+    zero_copy = measure(zc_send, make_zc_recv)
+    result = {
+        "metric": f"ps_wire round-trip ({tree_bytes / 2**20:.0f} MiB dense "
+                  f"pytree, {n_layers} layers)",
+        "unit": "MB/s",
+        "rows": {"legacy": round(legacy, 1), "zero_copy": round(zero_copy, 1)},
+        "speedup": round(zero_copy / legacy, 3),
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("ps_wire")
+        if recorded:
+            rec = recorded["speedup"]
+            threshold = recorded.get("threshold_pct", 15.0)
+            result["vs_recorded_speedup"] = round(result["speedup"] / rec, 4)
+            if result["speedup"] < rec * (1.0 - threshold / 100.0):
+                print(f"WARNING: ps_wire speedup {result['speedup']:.2f}x is "
+                      f"more than {threshold}% below the recorded {rec:.2f}x "
+                      f"— the zero-copy wire path regressed (see "
+                      f"PERF_BASELINE.json ps_wire)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    return result
+
+
 def unroll_sweep(factors):
     """Measure the fused multi-step path (``runner.run_many``) at each unroll
     factor and print ONE JSON line with the steps/s curve.
@@ -133,7 +257,22 @@ def main(argv=None):
              "print an unroll-curve JSON line instead of the flagship "
              "measurement; on CPU a tiny host-bound model isolates the "
              "dispatch overhead the fusion amortizes")
+    parser.add_argument(
+        "--wire", action="store_true",
+        help="measure the PS transport's zero-copy wire path (encode_parts/"
+             "sendmsg/recycled-buffer alias decode) against the legacy "
+             "copying codec on a >=32 MiB dense pytree round-trip, and diff "
+             "the speedup against the recorded ps_wire row in "
+             "PERF_BASELINE.json; CPU-only host work, runs anywhere")
+    parser.add_argument(
+        "--profile", type=int, default=0, metavar="N",
+        help="dump a jax.profiler trace (Perfetto/TensorBoard format) of an "
+             "N-step window after warmup; the trace directory is reported in "
+             "the JSON line as profile_trace")
     args = parser.parse_args(argv)
+    if args.wire:
+        wire_bench()
+        return
     if args.unroll:
         try:
             factors = [int(f) for f in args.unroll.split(",") if f.strip()]
@@ -197,6 +336,16 @@ def main(argv=None):
     for _ in range(2):
         loss = step(batch)
     _ = float(loss)
+    trace_dir = None
+    if args.profile > 0:
+        # Profiled window AFTER warmup (the trace sees steady-state steps,
+        # not compilation) and BEFORE the timed loop (tracing overhead must
+        # not contaminate the reported rate).
+        from autodist_tpu.utils import tracing
+        with tracing.trace("bench_flagship") as trace_dir:
+            for _ in range(args.profile):
+                loss = step(batch)
+            _ = float(loss)  # completion fence inside the traced window
     n_steps = 20 if on_accel else 3
     t0 = time.perf_counter()
     for _ in range(n_steps):
@@ -225,6 +374,8 @@ def main(argv=None):
         "flops_per_token": round(flops_per_token),
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
+    if trace_dir is not None:
+        result["profile_trace"] = trace_dir
     # Regression gate vs the recorded best (PERF_BASELINE.json): annotate the
     # JSON line and warn on stderr past the threshold. Round-over-round drift
     # was previously invisible (428.6k -> 425.8k went unremarked); this line

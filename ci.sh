@@ -73,6 +73,9 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "=== [4/4] bench smoke ==="
+# Wire micro-bench first: CPU-safe, sub-minute, and it gates the zero-copy
+# PS codec path against the recorded ps_wire row on every CI pass.
+python bench.py --wire
 python bench.py
 
 echo "=== CI OK ==="

@@ -94,7 +94,23 @@ def main(argv=None):
                              "--norm batch checkpoint normalizes with the "
                              "EVAL batch's own mean/var — accuracy depends "
                              "on eval batch size/composition (see "
-                             "docs/usage/performance.md)")
+                             "docs/usage/performance.md) — unless --bn_ema "
+                             "calibrates stored statistics first")
+    parser.add_argument("--bn_ema", type=int, default=0, metavar="N",
+                        help="--eval --norm batch only (default off): run N "
+                             "train-preprocessed calibration batches first, "
+                             "EMA each SyncBatchNorm site's (mean, var) into "
+                             "a bn_ema collection carried outside params, "
+                             "and evaluate with THOSE statistics — reference "
+                             "BatchNorm inference behavior, independent of "
+                             "eval batch size/composition")
+    parser.add_argument("--stages", type=str, default="",
+                        help="resnet-only: comma-separated residual block "
+                             "counts per stage overriding the model's "
+                             "default (resnet50=3,4,6,3) — a bring-up/smoke "
+                             "knob (e.g. --stages 1,1 compiles a 2-block "
+                             "model in seconds); benchmark rates are only "
+                             "comparable at the default depth")
     parser.add_argument("--input_mode", choices=["cache", "stream"],
                         default="cache",
                         help="--data_dir feed: 'cache' = HBM-resident record "
@@ -152,6 +168,14 @@ def main(argv=None):
     need_init = not (args.eval and args.restore)
     if args.model in ("resnet50", "resnet101"):
         stages = (3, 4, 23, 3) if args.model == "resnet101" else (3, 4, 6, 3)
+        if args.stages:
+            try:
+                stages = tuple(int(s) for s in args.stages.split(","))
+                if not stages or any(s < 1 for s in stages):
+                    raise ValueError
+            except ValueError:
+                parser.error(f"--stages needs comma-separated positive "
+                             f"integers, got {args.stages!r}")
         cfg = resnet.ResNet50Config(dtype=dtype, stage_sizes=stages,
                                     num_classes=num_classes, norm=args.norm)
         model = resnet.ResNet(cfg)
@@ -194,16 +218,50 @@ def main(argv=None):
 
     ad = AutoDist(args.resource_spec, build_strategy(args.strategy, args.model))
 
+    if args.bn_ema and not (args.eval and args.norm == "batch"
+                            and args.model in ("resnet50", "resnet101")):
+        parser.error("--bn_ema needs --eval and a resnet with --norm batch")
+
     if args.eval:
         if args.restore:
             from autodist_tpu.checkpoint import Saver
             params = Saver().restore_params(args.restore)
         from autodist_tpu.data import imagenet as imagenet_data
 
+        bn_stats = eval_model = None
+        if args.bn_ema:
+            # Calibration pass: N shuffled, train-preprocessed batches feed
+            # the EMA of per-site (mean, var); evaluation below then runs an
+            # EMA-reading model — stats carried outside params, params
+            # themselves untouched.
+            import dataclasses as _dc
+            cal_loader, _ = imagenet_data.open_image_loader(
+                args.data_dir, batch_size=batch_size, shuffle=True, prefetch=2)
+            cal_batcher = imagenet_data.AugmentingBatcher(
+                cal_loader, image_size=args.image_size,
+                record_size=meta["record_size"], train=True)
+
+            def _cal_images():
+                for _ in range(args.bn_ema):
+                    b = cal_batcher.next()
+                    yield imagenet_data.augment_images(
+                        b["images"], b["crop_yx"], b["flip"], args.image_size,
+                        dtype)
+
+            bn_stats = resnet.calibrate_bn_ema(model, params, _cal_images())
+            cal_loader.close()
+            eval_model = resnet.ResNet(_dc.replace(cfg, bn_ema=True))
+            print(f"calibrated SyncBatchNorm EMA over {args.bn_ema} "
+                  f"batch(es); evaluating with stored statistics")
+
         def metric_fn(p, b):
             x = imagenet_data.augment_images(b["images"], b["crop_yx"],
                                              b["flip"], args.image_size, dtype)
-            logits = model.apply({"params": p}, x).astype(jnp.float32)
+            if bn_stats is not None:
+                logits = eval_model.apply({"params": p, "bn_ema": bn_stats}, x)
+            else:
+                logits = model.apply({"params": p}, x)
+            logits = logits.astype(jnp.float32)
             top5 = jax.lax.top_k(logits, min(5, logits.shape[-1]))[1]
             c1 = (jnp.argmax(logits, -1) == b["labels"]).sum()
             c5 = (top5 == b["labels"][:, None]).any(-1).sum()

@@ -116,9 +116,12 @@ def test_lm1b_example_trains_from_disk_shards(tmp_path):
 
 def test_imagenet_benchmark_tiny():
     import examples.benchmark.imagenet as im
+    # --stages 1,1: the example's plumbing (flags, meter, MFU report) is what
+    # this smokes; the full-depth ResNet-50 costs ~100s of compile on the CPU
+    # test host for no extra example coverage.
     avg = im.main(["--model", "resnet50", "--strategy", "AllReduce",
                    "--steps", "3", "--batch_size", "8", "--image_size", "64",
-                   "--log_every", "2"])
+                   "--stages", "1,1", "--log_every", "2"])
     assert avg is None or avg >= 0
 
 

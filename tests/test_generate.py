@@ -114,20 +114,24 @@ def test_top_p_nucleus_sampling():
     """top_p keeps exactly the smallest head of the distribution reaching p
     (the token crossing the threshold included), never an empty nucleus."""
     # Row with known probabilities: softmax of these logits ~= [.6, .3, .1].
+    # One jitted vmap over 200 keys per p (a 200-key python loop of eager
+    # sample_logits dispatches costs ~25s of tier-1 budget for the same
+    # distributional evidence).
     logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.1]], jnp.float32))
-    keys = [jax.random.PRNGKey(i) for i in range(200)]
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(200))
+
+    def sweep(p, n=200):
+        draws = jax.jit(jax.vmap(
+            lambda k: sample_logits(logits, k, temperature=1.0, top_p=p)[0]
+        ))(keys[:n])
+        return set(np.asarray(draws).tolist())
+
     # p=0.5: nucleus = {0} (0.6 crosses the threshold) -> always token 0.
-    out = {int(sample_logits(logits, k, temperature=1.0, top_p=0.5)[0])
-           for k in keys}
-    assert out == {0}, out
+    assert sweep(0.5) == {0}
     # p=0.7: nucleus = {0, 1} (0.6 < p, +0.3 crosses) -> never token 2.
-    out = {int(sample_logits(logits, k, temperature=1.0, top_p=0.7)[0])
-           for k in keys}
-    assert out == {0, 1}, out
+    assert sweep(0.7) == {0, 1}
     # A tiny p still keeps the argmax (nucleus never empty).
-    out = {int(sample_logits(logits, k, temperature=1.0, top_p=1e-6)[0])
-           for k in keys[:20]}
-    assert out == {0}, out
+    assert sweep(1e-6, n=20) == {0}
     # Composes with top_k and threads through both generate APIs.
     cfg = _small_cfg()
     model, params = transformer_lm.init_params(cfg)

@@ -111,6 +111,32 @@ def test_resnet_sync_batchnorm_is_cross_replica():
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
+def test_resnet_sync_batchnorm_ema_inference_parity():
+    """The flag-gated BN-EMA eval mode: stats calibrated from ONE batch equal
+    that batch's own moments, so the EMA-reading model reproduces batch-stats
+    outputs exactly on it — and, unlike batch-stats mode, gives the same
+    per-example logits at ANY eval batch size (reference BatchNorm inference
+    behavior; stats live outside params)."""
+    import dataclasses
+
+    cfg = resnet.ResNet50Config(num_classes=4, stage_sizes=(1,), width=8,
+                                dtype=jnp.float32, norm="batch")
+    model, params = resnet.init_params(cfg, image_size=16)
+    rng = np.random.RandomState(0)
+    images = rng.randn(4, 16, 16, 3).astype(np.float32)
+
+    ema = resnet.calibrate_bn_ema(model, params, [images])
+    eval_model = resnet.ResNet(dataclasses.replace(cfg, bn_ema=True))
+    y_ema = np.asarray(eval_model.apply({"params": params, "bn_ema": ema},
+                                        images))
+    y_batch = np.asarray(model.apply({"params": params}, images))
+    np.testing.assert_allclose(y_ema, y_batch, rtol=1e-5, atol=1e-5)
+    # Batch-size independence: a singleton eval batch scores identically.
+    y_one = np.asarray(eval_model.apply({"params": params, "bn_ema": ema},
+                                        images[:1]))
+    np.testing.assert_allclose(y_one[0], y_ema[0], rtol=1e-5, atol=1e-5)
+
+
 def test_vgg_tiny_trains_partitioned_ps():
     model = vgg.VGG16(num_classes=10, dtype=jnp.float32)
     images = jnp.zeros((2, 32, 32, 3))
@@ -171,10 +197,12 @@ def test_densenet_tiny_trains():
 
 def test_inception_v3_tiny_trains():
     from autodist_tpu.models import inception
-    # Full-size stem needs 299px; a reduced 96px input still exercises every
-    # block type (A, B grid-reduce, C factorized-7x7, D, E).
+    # Full-size stem needs 299px; a reduced 96px input and one block per
+    # repeated stage still exercise every block type (A, B grid-reduce,
+    # C factorized-7x7, D, E) — the full 11-block graph costs ~80s of XLA
+    # compile on the CPU test host for no extra coverage.
     cfg = inception.InceptionV3Config(num_classes=10, dtype=jnp.float32,
-                                      norm_groups=4)
+                                      norm_groups=4, repeats=(1, 1, 1))
     model, params = inception.init_params(cfg, image_size=96)
     loss_fn = inception.make_loss_fn(model)
     batch = inception.synthetic_batch(cfg, batch_size=4, image_size=96)
