@@ -22,6 +22,12 @@ Layer map (mirrors reference SURVEY.md §1, re-targeted):
 
 from autodist_tpu.version import __version__
 
+# Typo'd flags (a misspelled AUTODIST_PS_OVERLAP etc.) silently no-op; warn
+# at import so they surface at startup instead of in a perf investigation.
+from autodist_tpu.const import warn_unknown_autodist_flags as _warn_flags
+
+_warn_flags()
+
 __all__ = ["AutoDist", "get_default_autodist", "ResourceSpec", "train",
            "__version__"]
 

@@ -1,0 +1,25 @@
+"""graftlint — project-specific static analysis for autodist_tpu.
+
+An AST-based analyzer (stdlib ``ast``/``tokenize`` only — importable with no
+jax present) that machine-enforces the hazard rules this codebase keeps
+re-learning the hard way: locks held across XLA dispatch (the PR 2 deadlock
+class), lock-order inversions, buffer use-after-donation, tracer leaks out of
+jitted functions, unbounded blocking in transport handlers, wire-protocol
+opcode exhaustiveness, the ``AUTODIST_*`` env-flag registry, and the tier-1
+test-window naming convention.
+
+Entry points:
+
+- ``tools/graftlint.py`` — the CLI (text/JSON output, ``--explain``, committed
+  baseline for grandfathered findings).
+- :func:`autodist_tpu.analysis.core.lint_paths` — the library API the test
+  suite's self-clean meta-test drives.
+
+Checks register themselves via :func:`autodist_tpu.analysis.core.register`;
+importing :mod:`autodist_tpu.analysis.checks` populates the registry. Inline
+suppression: ``# graftlint: disable=GL001(reason)`` — the reason is mandatory
+(a bare ``disable=GL001`` is itself a GL000 finding).
+"""
+
+from autodist_tpu.analysis.core import (  # noqa: F401
+    Context, Finding, LintResult, all_checks, lint_paths, register)

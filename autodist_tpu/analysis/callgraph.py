@@ -1,0 +1,154 @@
+"""Small intra-module AST call-graph utilities shared by the checks.
+
+Scope is deliberately one module: graftlint's concurrency checks need to see
+through local helpers (``_send_msg -> _send_payload -> sock.sendmsg``), not
+across the whole import graph. Resolution covers the two shapes this codebase
+uses: bare-name calls to module-level functions, and ``self.x()`` calls to
+methods of the enclosing class.
+"""
+
+import ast
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+
+def dotted_name(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain; None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_attr(node) -> Optional[str]:
+    """The final component of a Name/Attribute chain (``c`` of ``a.b.c``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def name_tokens(name: Optional[str]) -> Set[str]:
+    """Lower-cased underscore tokens of an identifier (``_write_mutex`` ->
+    {"write", "mutex"}). Token matching avoids substring traps ("block"
+    contains "lock")."""
+    if not name:
+        return set()
+    return {t for t in name.lower().split("_") if t}
+
+
+class ModuleIndex:
+    """Per-module map of callable definitions for bounded call resolution."""
+
+    def __init__(self, tree: ast.Module):
+        self.module_funcs: Dict[str, ast.FunctionDef] = {}
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.func_class: Dict[int, Optional[str]] = {}  # id(def) -> class name
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+                self.func_class[id(node)] = None
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.methods[(node.name, item.name)] = item
+                        self.func_class[id(item)] = node.name
+
+    def resolve(self, call: ast.Call,
+                current_class: Optional[str]) -> Optional[ast.FunctionDef]:
+        """The local FunctionDef a call lands in, when statically knowable."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.module_funcs.get(func.id)
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("self", "cls") and current_class:
+            return self.methods.get((current_class, func.attr))
+        return None
+
+
+def calls_under(node) -> Iterator[ast.Call]:
+    """Every Call node in ``node``'s subtree, in source order."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def walk_executed(node) -> Iterator[ast.AST]:
+    """``ast.walk`` that does NOT descend into function/lambda bodies:
+    code inside a ``def``/``lambda`` under a ``with lock:`` is *deferred* —
+    it runs when the callback is called, not while the lock is held — so
+    lock-holding analyses must skip it (the nested def gets analyzed in its
+    own right by module-wide walks). Decorators and argument defaults DO
+    execute in place and are walked. Applies to the start node too: to walk
+    a function's own body, iterate its ``.body`` statements."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(n.decorator_list)
+            stack.extend(n.args.defaults)
+            stack.extend(d for d in n.args.kw_defaults if d is not None)
+            continue
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def calls_executed(node) -> Iterator[ast.Call]:
+    """Call nodes that actually execute as part of ``node``'s own flow
+    (see :func:`walk_executed`)."""
+    for sub in walk_executed(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def find_reaching_call(
+        index: ModuleIndex, start_nodes: List[ast.AST],
+        current_class: Optional[str],
+        predicate: Callable[[ast.Call], Optional[str]],
+        max_depth: int = 5) -> Optional[Tuple[ast.Call, str, List[str]]]:
+    """BFS from ``start_nodes`` through locally-resolvable calls for the first
+    call where ``predicate`` returns a non-None label.
+
+    Returns ``(top_level_call, label, path)`` where ``top_level_call`` is the
+    call *in the start nodes* that leads there and ``path`` names the hop
+    chain (for the finding message). Depth-limited and cycle-safe."""
+    for top in start_nodes:
+        for call in calls_executed(top):
+            hit = _search(index, call, current_class, predicate,
+                          max_depth, visited=set())
+            if hit is not None:
+                label, path = hit
+                return call, label, path
+    return None
+
+
+def _search(index: ModuleIndex, call: ast.Call,
+            current_class: Optional[str], predicate, depth: int,
+            visited: Set[int]) -> Optional[Tuple[str, List[str]]]:
+    label = predicate(call)
+    name = dotted_name(call.func) or "<dynamic>"
+    if label is not None:
+        return label, [name]
+    if depth <= 0:
+        return None
+    target = index.resolve(call, current_class)
+    if target is None or id(target) in visited:
+        return None
+    visited.add(id(target))
+    callee_class = index.func_class.get(id(target), current_class)
+    for stmt in target.body:
+        for inner in calls_executed(stmt):
+            hit = _search(index, inner, callee_class, predicate, depth - 1,
+                          visited)
+            if hit is not None:
+                label, path = hit
+                return label, [name] + path
+    return None

@@ -1,0 +1,16 @@
+"""Check modules; importing this package populates the registry.
+
+Each module registers with :func:`autodist_tpu.analysis.core.register`.
+Check ownership:
+
+- concurrency:   GL001 lock-held-across-dispatch, GL002 lock-order,
+                 GL005 unbounded-blocking
+- donation:      GL003 use-after-donate
+- tracer:        GL004 tracer leak
+- wire_protocol: GL006 opcode/tag exhaustiveness + frame-version order
+- envflags:      GL007 AUTODIST_* flag registry
+- testlayout:    GL008 tier-1 test-window conventions
+"""
+
+from autodist_tpu.analysis.checks import (  # noqa: F401
+    concurrency, donation, envflags, testlayout, tracer, wire_protocol)

@@ -1,0 +1,283 @@
+"""Concurrency checks: GL001 lock-across-dispatch, GL002 lock order, GL005
+unbounded blocking.
+
+These descend from real bugs in this repo's history: PR 2 shipped a
+machine-dependent deadlock where concurrently dispatched multi-device XLA
+programs interleaved their collective rendezvous (fixed by
+``AsyncPSRunner._collective_lock``), and ``staleness.ParameterService``
+documents a strict ``_write_mutex -> _lock`` order plus a "device execution
+never runs under the snapshot lock" rule that nothing previously enforced.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from autodist_tpu.analysis import callgraph
+from autodist_tpu.analysis.core import Context, Finding, Module, register
+
+_LOCK_TOKENS = {"lock", "rlock", "mutex", "mtx", "cond", "condition",
+                "sem", "semaphore"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_DISPATCH_ATTRS = {"block_until_ready", "device_put", "device_get",
+                   "sendall", "sendmsg", "sendto", "recv", "recv_into",
+                   "recvfrom", "recvmsg", "connect", "accept"}
+_DISPATCH_METHODS = {"run", "run_many"}
+
+
+def _definite_locks(tree: ast.Module) -> Set[str]:
+    """Dotted targets assigned a ``threading.Lock()``-family constructor."""
+    locks: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        ctor = callgraph.last_attr(node.value.func)
+        if ctor not in _LOCK_CTORS:
+            continue
+        for target in node.targets:
+            name = callgraph.dotted_name(target)
+            if name:
+                locks.add(name)
+    return locks
+
+
+def _lock_name(expr, definite: Set[str]) -> Optional[str]:
+    """The lock's short name when ``expr`` looks like a lock, else None.
+    Either the expression was assigned a threading constructor in this module,
+    or its final identifier carries a lock-ish token (``_collective_lock``,
+    ``_write_mutex``, ``_cond`` — token match, so "block" never trips)."""
+    dotted = callgraph.dotted_name(expr)
+    last = callgraph.last_attr(expr)
+    if dotted is not None and dotted in definite:
+        return last or dotted
+    if callgraph.name_tokens(last) & _LOCK_TOKENS:
+        return last
+    return None
+
+
+def _jitted_names(tree: ast.Module) -> Set[str]:
+    """Dotted targets assigned from a ``jax.jit(...)``/``jit(...)`` call."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        fn = callgraph.dotted_name(node.value.func) or ""
+        if fn == "jit" or fn.endswith(".jit"):
+            for target in node.targets:
+                name = callgraph.dotted_name(target)
+                if name:
+                    names.add(name)
+    return names
+
+
+def _enclosing_class(module: Module, index: callgraph.ModuleIndex,
+                     node) -> Optional[str]:
+    """Class name owning ``node``'s enclosing method, for self-call resolution."""
+    scope = module.scope_at(node)
+    head = scope.split(".")[0] if scope else ""
+    if any(cls == head for cls, _ in index.methods):
+        return head
+    return None
+
+
+@register("GL001", "lock held across device dispatch / blocking I/O")
+def check_lock_across_dispatch(module: Module,
+                               ctx: Context) -> List[Finding]:
+    """GL001 — lock-held-across-dispatch.
+
+    Flags a ``with <lock>:`` body that reaches (directly or through
+    same-module helpers, up to 5 hops) a blocking operation: a jit-compiled
+    callable, ``runner.run``/``run_many``, ``jax.block_until_ready``, or
+    socket send/recv. Holding a lock across multi-device XLA execution can
+    wedge the collective rendezvous — the PR 2 deadlock, which hung the whole
+    tier-1 suite 3/3 on a 2-core box — and holding a hot-path snapshot lock
+    across device execution stalls every reader for a whole program
+    (the ``staleness.ParameterService`` rule: the apply's device execution
+    runs under the writer mutex only, never the snapshot Condition).
+
+    Locks that exist precisely to serialize execution (e.g.
+    ``AsyncPSRunner._collective_lock``) are legitimate; annotate those sites
+    with ``# graftlint: disable=GL001(reason)`` so the intent is explicit and
+    reviewed, instead of implicit and forgettable.
+    """
+    if module.tree is None:
+        return []
+    findings: List[Finding] = []
+    definite = _definite_locks(module.tree)
+    jitted = _jitted_names(module.tree)
+    index = callgraph.ModuleIndex(module.tree)
+
+    def predicate(call: ast.Call) -> Optional[str]:
+        dotted = callgraph.dotted_name(call.func)
+        last = callgraph.last_attr(call.func)
+        if last in _DISPATCH_ATTRS:
+            return dotted or last
+        if last in _DISPATCH_METHODS and isinstance(call.func, ast.Attribute):
+            return dotted or last
+        if dotted is not None and dotted in jitted:
+            return f"{dotted} (jitted)"
+        return None
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            lock = _lock_name(item.context_expr, definite)
+            if lock is None:
+                continue
+            cls = _enclosing_class(module, index, node)
+            hit = callgraph.find_reaching_call(
+                index, list(node.body), cls, predicate)
+            if hit is None:
+                continue
+            _, label, path = hit
+            via = " via " + " -> ".join(path[:-1]) if len(path) > 1 else ""
+            findings.append(Finding(
+                "GL001", module.relpath, node.lineno, node.col_offset,
+                f"lock `{lock}` is held across blocking call `{label}`{via}; "
+                f"dispatching device programs or socket I/O inside a critical "
+                f"section risks deadlocking the collective rendezvous "
+                f"(PR 2) and stalls every other thread on the lock",
+                scope=module.scope_at(node)))
+            break  # one finding per with-statement is enough signal
+    return findings
+
+
+def _nested_lock_edges(module: Module, index: callgraph.ModuleIndex,
+                       definite: Set[str]):
+    """(outer, inner, node) lock-acquisition edges: direct ``with`` nesting
+    plus one level of same-module call resolution."""
+    edges = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        outers = [_lock_name(i.context_expr, definite) for i in node.items]
+        outers = [o for o in outers if o]
+        if not outers:
+            continue
+        cls = _enclosing_class(module, index, node)
+        # walk_executed: a `with B:` inside a def merely DEFINED under A is
+        # deferred code — not an A->B acquisition.
+        inner_withs = [sub for body in node.body
+                       for sub in callgraph.walk_executed(body)
+                       if isinstance(sub, (ast.With, ast.AsyncWith))]
+        for call in (c for body in node.body
+                     for c in callgraph.calls_executed(body)):
+            target = index.resolve(call, cls)
+            if target is not None:
+                inner_withs.extend(
+                    sub for stmt in target.body
+                    for sub in callgraph.walk_executed(stmt)
+                    if isinstance(sub, (ast.With, ast.AsyncWith)))
+        for sub in inner_withs:
+            for item in sub.items:
+                inner = _lock_name(item.context_expr, definite)
+                if inner is None:
+                    continue
+                for outer in outers:
+                    if outer != inner:
+                        edges.append((outer, inner, sub))
+    return edges
+
+
+@register("GL002", "lock-order inversion / undeclared nesting")
+def check_lock_order(module: Module, ctx: Context) -> List[Finding]:
+    """GL002 — lock-order inversion.
+
+    Derives the acquisition order of named locks (direct ``with`` nesting
+    plus one level of same-module calls) and flags (a) any pair acquired in
+    both orders anywhere in the module — a classic ABBA deadlock — and
+    (b) any nested acquisition not covered by a declared order directive.
+    Declare the module's intended order once, next to the lock definitions:
+
+        # graftlint: lock-order=_write_mutex->_lock
+
+    The directive is the machine-readable version of the prose rule
+    ``staleness.ParameterService`` always had ("Order: _write_mutex ->
+    _lock, never the reverse"); with it declared, a future path acquiring
+    ``_lock`` then ``_write_mutex`` fails lint instead of deadlocking a
+    production chief under load.
+    """
+    if module.tree is None:
+        return []
+    findings: List[Finding] = []
+    definite = _definite_locks(module.tree)
+    index = callgraph.ModuleIndex(module.tree)
+    declared = set(module.lock_orders)
+    seen: Dict[Tuple[str, str], ast.AST] = {}
+    reported: Set[Tuple[str, str, str]] = set()
+
+    for outer, inner, node in _nested_lock_edges(module, index, definite):
+        scope = module.scope_at(node)
+        if (outer, inner, scope) in reported:
+            continue
+        reported.add((outer, inner, scope))
+        if (inner, outer) in seen or (inner, outer) in declared:
+            findings.append(Finding(
+                "GL002", module.relpath, node.lineno, node.col_offset,
+                f"acquires `{inner}` while holding `{outer}`, conflicting "
+                f"with the established order `{inner}` -> `{outer}`; "
+                f"two threads taking these locks in opposite orders "
+                f"deadlock each other",
+                scope=scope))
+        elif (outer, inner) not in declared:
+            findings.append(Finding(
+                "GL002", module.relpath, node.lineno, node.col_offset,
+                f"nested lock acquisition `{outer}` -> `{inner}` has no "
+                f"declared order; add `# graftlint: "
+                f"lock-order={outer}->{inner}` at module level so future "
+                f"paths cannot silently invert it",
+                scope=scope))
+        seen.setdefault((outer, inner), node)
+    return findings
+
+
+@register("GL005", "unbounded blocking wait in runtime code")
+def check_unbounded_wait(module: Module, ctx: Context) -> List[Finding]:
+    """GL005 — blocking call without a timeout path.
+
+    In ``autodist_tpu/`` runtime code (handlers the PS transport runs per
+    connection, gate waits, prefetch joins), flags ``Condition.wait`` /
+    ``wait_for`` / ``Event.wait`` calls with no timeout argument (or a
+    literal ``None``): a dead peer or wedged producer then parks the thread
+    forever with no diagnosable failure. The PS server bounds the
+    wait-indefinitely gate default for the same reason
+    (``ps_transport._dispatch``: client-requested finite timeouts are
+    honored exactly; ``None`` gets a 24h ceiling so a vanished peer cannot
+    park handler threads forever). Tests and tools are exempt (a test
+    hanging is loud; a server thread leaking is silent).
+    """
+    if module.tree is None or not module.relpath.startswith("autodist_tpu/"):
+        return []
+    findings: List[Finding] = []
+    for call in callgraph.calls_under(module.tree):
+        last = callgraph.last_attr(call.func)
+        if last not in ("wait", "wait_for"):
+            continue
+        if last == "wait":
+            receiver = call.func.value if isinstance(call.func, ast.Attribute) \
+                else None
+            tokens = callgraph.name_tokens(callgraph.last_attr(receiver))
+            if not tokens & (_LOCK_TOKENS | {"event", "ev", "done", "ready"}):
+                continue  # p.wait() on a process etc. — not a lock primitive
+            has_timeout = bool(call.args) or any(
+                k.arg == "timeout" for k in call.keywords)
+            timeout_arg = call.args[0] if call.args else next(
+                (k.value for k in call.keywords if k.arg == "timeout"), None)
+        else:
+            has_timeout = len(call.args) >= 2 or any(
+                k.arg == "timeout" for k in call.keywords)
+            timeout_arg = call.args[1] if len(call.args) >= 2 else next(
+                (k.value for k in call.keywords if k.arg == "timeout"), None)
+        if has_timeout and not (isinstance(timeout_arg, ast.Constant)
+                                and timeout_arg.value is None):
+            continue
+        dotted = callgraph.dotted_name(call.func) or last
+        findings.append(Finding(
+            "GL005", module.relpath, call.lineno, call.col_offset,
+            f"unbounded `{dotted}` — no timeout, so a dead peer or wedged "
+            f"producer parks this thread forever; pass a timeout and handle "
+            f"expiry (see StalenessController.start_step)",
+            scope=module.scope_at(call)))
+    return findings

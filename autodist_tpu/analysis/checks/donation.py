@@ -1,0 +1,126 @@
+"""GL003 — use-after-donate.
+
+``runner.py`` builds its compiled steps with ``donate_argnums=(0,)``: the
+input TrainState's buffers are handed to XLA for in-place reuse, and reading
+the donated tree afterwards raises (or, on some backends, returns freed
+memory). The hazard is invisible at the call site — the variable still looks
+alive in Python — so this check tracks locals passed at donated positions and
+flags later reads.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from autodist_tpu.analysis import callgraph
+from autodist_tpu.analysis.core import Context, Finding, Module, register
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Literal donate_argnums positions of a ``jax.jit(...)`` call, when
+    statically knowable (int or tuple/list of ints); None otherwise."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for elt in v.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    return None
+                out.add(elt.value)
+            return out
+        return None
+    return None
+
+
+def _scopes(tree: ast.Module):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_scope(scope):
+    """Nodes of this scope's OWN executed flow (if/try bodies included,
+    nested defs excluded — they are yielded by :func:`_scopes` separately)."""
+    starts = scope.body \
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+        else [scope]
+    for start in starts:
+        yield from callgraph.walk_executed(start)
+
+
+@register("GL003", "use of a buffer after donation to a jitted call")
+def check_use_after_donate(module: Module, ctx: Context) -> List[Finding]:
+    """GL003 — use-after-donate.
+
+    Within one function (or module) scope: a name assigned
+    ``f = jax.jit(g, donate_argnums=...)`` with literal argnums, later called
+    ``f(x, ...)`` with a plain variable at a donated position, and that
+    variable read again afterwards (before any rebinding) — flagged at the
+    offending read. Donated buffers are deleted by XLA on dispatch; the read
+    raises ``RuntimeError: Array has been deleted`` at best. The repo-wide
+    convention this encodes: after ``new_state = step_fn(state, batch)`` the
+    old ``state`` is dead (see ``DistributedRunner.run``), and the async
+    runners disable donation entirely because stale workers legitimately
+    hold old parameter snapshots (``AsyncPSRunner.__init__``).
+
+    Only same-scope, literal-argnums flows are tracked; dynamic wiring (like
+    runner.py's ``donate = (0,) if self._donate else ()``) is out of scope by
+    design — the check is a tripwire for the common direct pattern, not an
+    escape analysis.
+    """
+    if module.tree is None:
+        return []
+    findings: List[Finding] = []
+    for scope in _scopes(module.tree):
+        # jitted-with-donation names assigned anywhere in THIS scope's own
+        # flow (if/try bodies included; nested defs are their own scope —
+        # walk_executed keeps the per-scope analyses disjoint).
+        donors: Dict[str, Set[int]] = {}
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                fn = callgraph.dotted_name(node.value.func) or ""
+                if fn == "jit" or fn.endswith(".jit"):
+                    positions = _donated_positions(node.value)
+                    if positions:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                donors[t.id] = positions
+        if not donors:
+            continue
+        # donation events: (var, call_line)
+        events: List[Tuple[str, int]] = []
+        for sub in _walk_scope(scope):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                    and sub.func.id in donors:
+                for pos in donors[sub.func.id]:
+                    if pos < len(sub.args) \
+                            and isinstance(sub.args[pos], ast.Name):
+                        events.append((sub.args[pos].id, sub.lineno))
+        for var, call_line in events:
+            # First rebinding at/after the call ends the donated window —
+            # same-line counts: `state = step(state, ...)` rebinds the name
+            # to the call's (live) result.
+            rebind = min((n.lineno for n in _walk_scope(scope)
+                          if isinstance(n, ast.Name) and n.id == var
+                          and isinstance(n.ctx, ast.Store)
+                          and n.lineno >= call_line), default=None)
+            for n in _walk_scope(scope):
+                if isinstance(n, ast.Name) and n.id == var \
+                        and isinstance(n.ctx, ast.Load) \
+                        and n.lineno > call_line \
+                        and (rebind is None or n.lineno < rebind):
+                    findings.append(Finding(
+                        "GL003", module.relpath, n.lineno, n.col_offset,
+                        f"`{var}` was passed at a donated position of a "
+                        f"jitted call and is read afterwards; donated "
+                        f"buffers are deleted by XLA (use the call's result, "
+                        f"or drop donate_argnums)",
+                        scope=module.scope_at(n)))
+                    break  # one finding per donation event
+    return findings

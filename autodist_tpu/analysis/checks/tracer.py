@@ -1,0 +1,134 @@
+"""GL004 — tracer leaks and host effects inside jit-compiled functions.
+
+A jitted body runs ONCE at trace time with abstract tracers; host-side
+effects inside it (mutating captured objects, ``print``, ``time``/``random``
+reads) either leak tracers onto live objects — poisoning later non-traced
+code with escaped-tracer errors — or silently bake a trace-time value into
+the compiled program forever (a ``time.time()`` timestamp, a ``random``
+draw). Both bug classes are invisible until a cache hit skips the retrace.
+"""
+
+import ast
+from typing import List, Set
+
+from autodist_tpu.analysis import callgraph
+from autodist_tpu.analysis.core import Context, Finding, Module, register
+
+_IMPURE_EXACT = {"print", "input", "breakpoint", "open"}
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "jax.debug.breakpoint")
+
+
+def _is_jit_decorator(dec) -> bool:
+    name = callgraph.dotted_name(dec)
+    if name is not None:
+        return name == "jit" or name.endswith(".jit")
+    if isinstance(dec, ast.Call):
+        fn = callgraph.dotted_name(dec.func) or ""
+        if fn == "jit" or fn.endswith(".jit"):
+            return True
+        if fn.endswith("partial") and dec.args:
+            first = callgraph.dotted_name(dec.args[0]) or ""
+            return first == "jit" or first.endswith(".jit")
+    return False
+
+
+def _jitted_defs(tree: ast.Module):
+    """FunctionDefs compiled by jit: decorated, or passed to ``jax.jit(f)``."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = callgraph.dotted_name(node.func) or ""
+            if (fn == "jit" or fn.endswith(".jit")) and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                wrapped.add(node.args[0].id)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(_is_jit_decorator(d) for d in node.decorator_list) \
+                or node.name in wrapped:
+            yield node
+
+
+def _bound_names(fn) -> Set[str]:
+    """Names bound inside the function: locals (any Name store anywhere in
+    the body, including nested defs/comprehensions) — NOT the parameters:
+    storing attributes onto a parameter is itself a leak (arguments are
+    tracers/pytrees owned by the caller)."""
+    bound: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            bound.add(node.name)
+    return bound
+
+
+@register("GL004", "host effect / state mutation inside a jitted function")
+def check_tracer_leak(module: Module, ctx: Context) -> List[Finding]:
+    """GL004 — tracer leak.
+
+    Inside a jit-compiled function (``@jax.jit``-decorated, or a local def
+    later wrapped ``jax.jit(f)``), flags:
+
+    - ``global``/``nonlocal`` declarations — mutating outer scope under
+      trace stores a tracer (or a trace-time constant) where runtime code
+      will read it;
+    - attribute stores onto objects the function did not create
+      (``self.x = ...``, ``captured.field = ...``) — the classic escaped
+      tracer, which surfaces later as an UnexpectedTracerError in unrelated
+      code (locals created inside the body are fine);
+    - host-effect calls (``print``, ``time.*``, ``random.*``,
+      ``np.random.*``, ``open``): they run once at trace time, so their
+      value/effect is frozen into the executable — a jitted step "logging"
+      via print prints once per compile, not per step, and a ``random``
+      draw becomes a compile-time constant. Use ``jax.debug.print`` /
+      ``jax.random`` with threaded keys instead.
+
+    The repo keeps jitted bodies pure by construction (see
+    ``runner._make_step_body``); this check keeps them that way.
+    """
+    if module.tree is None:
+        return []
+    findings: List[Finding] = []
+    for fn in _jitted_defs(module.tree):
+        bound = _bound_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                findings.append(Finding(
+                    "GL004", module.relpath, node.lineno, node.col_offset,
+                    f"`{kind} {', '.join(node.names)}` inside jitted "
+                    f"`{fn.name}`: mutating outer scope under trace leaks "
+                    f"tracers / freezes trace-time values",
+                    scope=module.scope_at(node)))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if not isinstance(t, ast.Attribute):
+                        continue
+                    root = t
+                    while isinstance(root, ast.Attribute):
+                        root = root.value
+                    if isinstance(root, ast.Name) and root.id not in bound:
+                        findings.append(Finding(
+                            "GL004", module.relpath, node.lineno,
+                            node.col_offset,
+                            f"jitted `{fn.name}` stores onto captured object "
+                            f"`{callgraph.dotted_name(t)}`: traced values "
+                            f"escaping onto live objects poison later "
+                            f"non-traced code (UnexpectedTracerError)",
+                            scope=module.scope_at(node)))
+            elif isinstance(node, ast.Call):
+                name = callgraph.dotted_name(node.func) or ""
+                if name in _IMPURE_EXACT \
+                        or name.startswith(_IMPURE_PREFIXES):
+                    findings.append(Finding(
+                        "GL004", module.relpath, node.lineno, node.col_offset,
+                        f"host call `{name}` inside jitted `{fn.name}` runs "
+                        f"once at trace time, not per step (use "
+                        f"jax.debug.print / jax.random with threaded keys)",
+                        scope=module.scope_at(node)))
+    return findings

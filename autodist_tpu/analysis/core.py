@@ -1,0 +1,404 @@
+"""graftlint engine: parsing, directives, check registry, baseline, output.
+
+Design notes:
+
+- One :class:`Module` per source file: the ast tree, the raw lines, and every
+  ``# graftlint:`` directive found by a ``tokenize`` pass (comments are not in
+  the AST). Checks receive the Module plus a repo-level :class:`Context` and
+  return :class:`Finding` lists; the engine applies suppressions and the
+  baseline afterwards so checks stay oblivious to both.
+- Finding fingerprints are line-number-free — ``check|path|scope|message`` —
+  so a committed baseline survives unrelated edits above a grandfathered
+  finding. ``scope`` is the enclosing def/class qualname.
+- GL000 is the analyzer's own meta-check (malformed directives, reasonless
+  suppressions, unparseable files). GL000 findings cannot be suppressed —
+  otherwise a typo'd suppression could silence the report about itself.
+"""
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+META_CHECK = "GL000"
+_CHECK_ID_RE = re.compile(r"^GL\d{3}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``scope`` + ``message`` (not line) key the baseline."""
+
+    check: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    scope: str = ""    # enclosing def/class qualname ("" = module level)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.check}|{self.path}|{self.scope}|{self.message}"
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.check} {self.message}{scope}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]            # new findings (post-suppress, post-baseline)
+    suppressed: List[Tuple[Finding, str]]   # (finding, reason)
+    baselined: List[Finding]
+    stale_baseline: List[str]          # fingerprints no longer produced
+    files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+class Check:
+    """Registry entry: id, one-line title, the check fn, and --explain docs."""
+
+    def __init__(self, check_id: str, title: str, fn: Callable, doc: str):
+        self.id = check_id
+        self.title = title
+        self.fn = fn
+        self.doc = doc or ""
+
+
+_CHECKS: Dict[str, Check] = {}
+
+
+def register(check_id: str, title: str):
+    """Decorator registering ``fn(module, ctx) -> [Finding]`` under ``GLxxx``."""
+    if not _CHECK_ID_RE.match(check_id):
+        raise ValueError(f"check id must match GLnnn, got {check_id!r}")
+
+    def deco(fn):
+        if check_id in _CHECKS:
+            raise ValueError(f"duplicate check id {check_id}")
+        _CHECKS[check_id] = Check(check_id, title, fn, fn.__doc__)
+        return fn
+
+    return deco
+
+
+def all_checks() -> Dict[str, Check]:
+    """The registry, with the built-in check modules imported."""
+    from autodist_tpu.analysis import checks  # noqa: F401  (side effect: registration)
+    return dict(_CHECKS)
+
+
+# ------------------------------------------------------------------ directives
+
+_DIRECTIVE_RE = re.compile(r"#\s*graftlint\s*:\s*(.+?)\s*$")
+_DISABLE_ENTRY_RE = re.compile(r"(GL\d{3})\s*(\(([^()]*)\))?")
+_LOCK_ORDER_RE = re.compile(
+    r"lock-order\s*=\s*([A-Za-z_][\w]*)\s*->\s*([A-Za-z_][\w]*)")
+
+
+class Module:
+    """One parsed source file plus its graftlint directives."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[Finding] = None
+        # line -> {check_id: reason}
+        self.suppressions: Dict[int, Dict[str, str]] = {}
+        self.lock_orders: List[Tuple[str, str]] = []
+        self.directive_findings: List[Finding] = []
+        self._scopes: Optional[List[Tuple[int, int, str]]] = None
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:
+            self.parse_error = Finding(
+                META_CHECK, self.relpath, e.lineno or 1, e.offset or 0,
+                f"file does not parse: {e.msg}")
+        self._scan_directives()
+
+    # -- directives ---------------------------------------------------------
+    def _scan_directives(self):
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            return  # the parse_error finding already covers it
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DIRECTIVE_RE.search(tok.string)
+            if m is None:
+                continue
+            line = tok.start[0]
+            standalone = not self.lines[line - 1][:tok.start[1]].strip()
+            target = self._next_code_line(line + 1) if standalone else line
+            self._parse_directive(m.group(1), line, target)
+
+    def _next_code_line(self, start: int) -> int:
+        for i in range(start, len(self.lines) + 1):
+            text = self.lines[i - 1].strip()
+            if text and not text.startswith("#"):
+                return i
+        return start
+
+    def _parse_directive(self, body: str, line: int, target: int):
+        recognized = False
+        if "disable" in body:
+            recognized = True
+            # Everything after "disable=" is the entry list.
+            _, _, entries = body.partition("disable")
+            entries = entries.lstrip("= ")
+            matched_any = False
+            for m in _DISABLE_ENTRY_RE.finditer(entries):
+                matched_any = True
+                check_id, reason = m.group(1), (m.group(3) or "").strip()
+                if not reason:
+                    self.directive_findings.append(Finding(
+                        META_CHECK, self.relpath, line, 0,
+                        f"suppression of {check_id} has no reason; write "
+                        f"`# graftlint: disable={check_id}(why it is safe)`"))
+                    continue
+                if check_id == META_CHECK:
+                    self.directive_findings.append(Finding(
+                        META_CHECK, self.relpath, line, 0,
+                        "GL000 (analyzer meta findings) cannot be suppressed"))
+                    continue
+                self.suppressions.setdefault(target, {})[check_id] = reason
+            if not matched_any:
+                self.directive_findings.append(Finding(
+                    META_CHECK, self.relpath, line, 0,
+                    f"malformed disable directive {body!r}; expected "
+                    f"`disable=GLnnn(reason)`"))
+        for m in _LOCK_ORDER_RE.finditer(body):
+            recognized = True
+            self.lock_orders.append((m.group(1), m.group(2)))
+        if not recognized:
+            self.directive_findings.append(Finding(
+                META_CHECK, self.relpath, line, 0,
+                f"unrecognized graftlint directive {body!r} (known: "
+                f"disable=GLnnn(reason), lock-order=a->b)"))
+
+    def suppression_for(self, finding: Finding) -> Optional[str]:
+        """The reason suppressing ``finding``, or None. A directive applies to
+        its own line (trailing comment) or, standalone, to the next code line."""
+        if finding.check == META_CHECK:
+            return None
+        by_line = self.suppressions.get(finding.line)
+        if by_line and finding.check in by_line:
+            return by_line[finding.check]
+        return None
+
+    # -- scopes -------------------------------------------------------------
+    def scope_at(self, node_or_line) -> str:
+        """Innermost enclosing def/class qualname for a node or line number."""
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if self._scopes is None:
+            self._scopes = []
+            if self.tree is not None:
+                self._collect_scopes(self.tree, "")
+        best = ""
+        best_span = None
+        for start, end, name in self._scopes:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = name, span
+        return best
+
+    def _collect_scopes(self, node, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                self._scopes.append(
+                    (child.lineno, child.end_lineno or child.lineno, qual))
+                self._collect_scopes(child, qual)
+            else:
+                self._collect_scopes(child, prefix)
+
+
+class Context:
+    """Repo-level facts shared across modules (const.py flag registry,
+    pyproject markers). Lazily computed, overridable for fixture tests."""
+
+    def __init__(self, root: str, known_flags: Optional[Set[str]] = None):
+        self.root = root
+        self._known_flags = known_flags
+        self._pyproject_markers: Optional[Set[str]] = None
+
+    def known_flags(self) -> Optional[Set[str]]:
+        """AUTODIST_* names registered in const.py's KNOWN_FLAGS (falling back
+        to _ENV_DEFAULTS keys); None when const.py is absent (fixture trees),
+        which disables the unknown-flag rule rather than flagging everything."""
+        if self._known_flags is not None:
+            return self._known_flags
+        const_path = os.path.join(self.root, "autodist_tpu", "const.py")
+        if not os.path.isfile(const_path):
+            return None
+        flags: Set[str] = set()
+        try:
+            with open(const_path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) \
+                        and target.id in ("KNOWN_FLAGS", "_ENV_DEFAULTS") \
+                        and isinstance(node.value, ast.Dict):
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Constant) \
+                                and isinstance(key.value, str):
+                            flags.add(key.value)
+        self._known_flags = flags or None
+        return self._known_flags
+
+    def pyproject_markers(self) -> Set[str]:
+        """Marker names registered under [tool.pytest.ini_options] markers."""
+        if self._pyproject_markers is not None:
+            return self._pyproject_markers
+        markers: Set[str] = set()
+        path = os.path.join(self.root, "pyproject.toml")
+        if os.path.isfile(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                text = ""
+            # A full TOML parse is overkill for one list of "name: help" strings.
+            for m in re.finditer(r'"([A-Za-z_][\w]*)\s*:', text):
+                markers.add(m.group(1))
+        self._pyproject_markers = markers
+        return markers
+
+
+# -------------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> Set[str]:
+    """Fingerprints grandfathered by the committed baseline file."""
+    if not path or not os.path.isfile(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    """Rewrite the baseline from the current findings (sorted, stable diffs).
+    GL000 meta-findings (malformed directives etc.) are never written: they
+    must be fixed, not grandfathered — the baseline matcher ignores them
+    anyway (see :func:`lint_paths`)."""
+    entries = sorted(
+        ({"fingerprint": f.fingerprint, "note": f.render()}
+         for f in findings if f.check != META_CHECK),
+        key=lambda e: e["fingerprint"])
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "graftlint grandfathered findings; new findings "
+                              "fail CI, these do not. Regenerate with "
+                              "tools/graftlint.py --write-baseline.",
+                   "findings": entries}, f, indent=1)
+        f.write("\n")
+
+
+# ------------------------------------------------------------------ file walks
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules", "native"}
+
+
+def iter_py_files(paths: Sequence[str], root: str):
+    """Yield .py files under ``paths`` (files taken verbatim, dirs walked).
+    A nonexistent path raises: a CI gate that silently lints 0 files on a
+    typo'd/renamed path would green-light everything it exists to block."""
+    seen = set()
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            raise FileNotFoundError(f"graftlint: path does not exist: {p}")
+        if os.path.isfile(full):
+            if full not in seen:
+                seen.add(full)
+                yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    f = os.path.join(dirpath, name)
+                    if f not in seen:
+                        seen.add(f)
+                        yield f
+
+
+# ---------------------------------------------------------------------- driver
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               baseline: Optional[Set[str]] = None,
+               checks: Optional[Sequence[str]] = None,
+               context: Optional[Context] = None) -> LintResult:
+    """Run the registry over ``paths``; returns the triaged result.
+
+    ``baseline`` is a fingerprint set (see :func:`load_baseline`); matching
+    findings are reported separately and do not fail the run. ``checks``
+    restricts to a subset of check ids (fixture tests)."""
+    root = os.path.abspath(root or os.getcwd())
+    ctx = context or Context(root)
+    registry = all_checks()
+    selected = [registry[c] for c in checks] if checks \
+        else list(registry.values())
+    baseline = baseline or set()
+
+    raw: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    files = 0
+    for path in iter_py_files(paths, root):
+        files += 1
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            raw.append(Finding(META_CHECK, rel.replace(os.sep, "/"), 1, 0,
+                               f"unreadable file: {e}"))
+            continue
+        mod = Module(path, rel, source)
+        raw.extend(mod.directive_findings)
+        if mod.parse_error is not None:
+            raw.append(mod.parse_error)
+            continue
+        for check in selected:
+            for finding in check.fn(mod, ctx):
+                reason = mod.suppression_for(finding)
+                if reason is not None:
+                    suppressed.append((finding, reason))
+                else:
+                    raw.append(finding)
+
+    # GL000 never matches the baseline: grandfathering a malformed/reasonless
+    # directive would defeat the "GL000 cannot be suppressed" invariant
+    # through the --write-baseline side door.
+    new = [f for f in raw
+           if f.check == META_CHECK or f.fingerprint not in baseline]
+    grandfathered = [f for f in raw
+                     if f.check != META_CHECK and f.fingerprint in baseline]
+    stale = sorted(baseline - {f.fingerprint for f in raw})
+    order = lambda f: (f.path, f.line, f.col, f.check)  # noqa: E731
+    return LintResult(findings=sorted(new, key=order),
+                      suppressed=suppressed,
+                      baselined=sorted(grandfathered, key=order),
+                      stale_baseline=stale,
+                      files_checked=files)

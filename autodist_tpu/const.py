@@ -35,6 +35,58 @@ MAX_INT32 = 2**31 - 1
 MAX_INT64 = 2**63 - 1
 
 
+# Registry of EVERY AUTODIST_* environment flag the project reads anywhere —
+# package, tests, tools, CI scripts. One line of doc per flag. graftlint's
+# GL007 check parses this dict statically (an AUTODIST_* string literal not
+# listed here fails lint — the typo tripwire), and
+# :func:`warn_unknown_autodist_flags` enforces it at runtime for flags that
+# are SET with a typo (a misspelled AUTODIST_PS_OVERLAP would otherwise
+# silently leave the default on). Flags with typed defaults additionally get
+# an ENV member below; test-harness-only knobs are registry-only.
+KNOWN_FLAGS = {
+    "AUTODIST_WORKING_DIR": "root for strategies/logs/traces/checkpoints",
+    "AUTODIST_WORKER": "non-empty => this process is a worker replica",
+    "AUTODIST_STRATEGY_ID": "strategy id shipped by the chief",
+    "AUTODIST_MIN_LOG_LEVEL": "framework logger verbosity",
+    "AUTODIST_IS_TESTING": "extra invariants under test",
+    "AUTODIST_DEBUG_REMOTE": "verbose remote launch logging",
+    "AUTODIST_INTERNAL_TF": "API parity no-op",
+    "AUTODIST_PATCH_TF": "API parity no-op",
+    "AUTODIST_COORDINATOR_ADDR": "ip:port of jax.distributed coordinator",
+    "AUTODIST_COORDINATOR_PORT": "chief's coordinator port",
+    "AUTODIST_NUM_PROCESSES": "multi-host process count",
+    "AUTODIST_PROCESS_ID": "this process's rank",
+    "AUTODIST_PS_ADDR": "async-PS transport host:port",
+    "AUTODIST_PS_OVERLAP": "overlapped PS client (0 = serial pulls)",
+    "AUTODIST_DUMP_GRAPHS": "dump jaxpr/StableHLO per build stage",
+    "AUTODIST_NATIVE_TRANSPORT": "0/false disables the native send/recv lib",
+    "AUTODIST_PEAK_FLOPS": "per-device peak FLOP/s override for MFU math",
+    "AUTODIST_BENCHMARK_LOG_DIR": "benchmark metric file sink directory",
+    # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
+    "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
+    "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
+    "AUTODIST_MATRIX_CKPT_DIR": "strategy-matrix checkpoint dir (tests)",
+    "AUTODIST_DRYRUN_MULTIPROCESS": "skip real-process dryrun legs",
+    "AUTODIST_CI_SERIAL": "ci.sh: single-process pytest instead of shards",
+    "AUTODIST_SSH_SHIM_LOG": "docker/ssh_shim call-log path (dist tests)",
+}
+
+
+def warn_unknown_autodist_flags():
+    """Warn (once per process) about AUTODIST_* env vars that are not in
+    :data:`KNOWN_FLAGS` — a typo'd flag silently becomes a no-op otherwise.
+    Called at package import; returns the unknown names for tests."""
+    unknown = sorted(k for k in os.environ
+                     if k.startswith("AUTODIST_") and k not in KNOWN_FLAGS)
+    if unknown:
+        from autodist_tpu.utils import logging
+        logging.warning(
+            "Unknown AUTODIST_* environment variable(s): %s — not a "
+            "recognized flag (typo? see autodist_tpu/const.py KNOWN_FLAGS "
+            "for the registry)", ", ".join(unknown))
+    return unknown
+
+
 # Defaults for the ENV enum below. Kept outside the enum body: members whose values
 # compare equal would silently become enum *aliases* (all reading the first member's
 # env var), so each member's value is its own name.
@@ -62,6 +114,13 @@ _ENV_DEFAULTS = {
     "AUTODIST_PS_OVERLAP": True,
     # Dump jaxpr/StableHLO per build stage (reference graph visualizer parity).
     "AUTODIST_DUMP_GRAPHS": False,
+    # Native C send/recv plane for the PS transport ("0"/"false" disables;
+    # the zero-copy Python plane is used either way on pooled hot paths).
+    "AUTODIST_NATIVE_TRANSPORT": True,
+    # Per-device peak FLOP/s override for MFU reporting (utils/flops.py).
+    "AUTODIST_PEAK_FLOPS": "",
+    # Directory for benchmark metric files (utils/benchmark_logger.py).
+    "AUTODIST_BENCHMARK_LOG_DIR": "",
 }
 
 class ENV(enum.Enum):
@@ -83,6 +142,9 @@ class ENV(enum.Enum):
     AUTODIST_PS_ADDR = "AUTODIST_PS_ADDR"
     AUTODIST_PS_OVERLAP = "AUTODIST_PS_OVERLAP"
     AUTODIST_DUMP_GRAPHS = "AUTODIST_DUMP_GRAPHS"
+    AUTODIST_NATIVE_TRANSPORT = "AUTODIST_NATIVE_TRANSPORT"
+    AUTODIST_PEAK_FLOPS = "AUTODIST_PEAK_FLOPS"
+    AUTODIST_BENCHMARK_LOG_DIR = "AUTODIST_BENCHMARK_LOG_DIR"
 
     @property
     def val(self):
@@ -96,6 +158,14 @@ class ENV(enum.Enum):
         if isinstance(default, int):
             return int(raw)
         return raw
+
+
+# Every typed ENV flag must be registered (GL007/warn_unknown parse/scan
+# KNOWN_FLAGS, not _ENV_DEFAULTS — an unregistered member would make its own
+# uses fail lint).
+_unregistered = [k for k in _ENV_DEFAULTS
+                 if k.startswith("AUTODIST_") and k not in KNOWN_FLAGS]
+assert not _unregistered, f"ENV flags missing from KNOWN_FLAGS: {_unregistered}"
 
 
 def is_worker() -> bool:

@@ -95,7 +95,8 @@ def _native_transport():
         import ctypes
 
         from autodist_tpu.utils.native_build import build_native_lib
-        if os.environ.get("AUTODIST_NATIVE_TRANSPORT", "1") in ("0", "false"):
+        from autodist_tpu import const
+        if not const.ENV.AUTODIST_NATIVE_TRANSPORT.val:
             _TR_FAILED = True
             return None
         src = os.path.join(os.path.dirname(__file__), "native", "transport.cc")
@@ -447,7 +448,16 @@ class PSServer:
         try:
             if op == "start_step":
                 _, worker_id, timeout = msg
-                gen = r.controller.start_step(worker_id, timeout)
+                # A client-requested timeout is honored exactly (a finite
+                # wait re-raises StalenessTimeout to that client only). The
+                # wait-indefinitely default (None) is bounded at 24h purely
+                # so a vanished peer cannot park this handler thread forever
+                # — the recv loop shares this thread, so a dead socket never
+                # wakes a parked wait (graftlint GL005's rule at the trust
+                # boundary); a staleness stall that long is operationally
+                # dead anyway.
+                gen = r.controller.start_step(
+                    worker_id, 86400.0 if timeout is None else float(timeout))
                 return ("ok", gen)
             if op == "read":
                 params, ef_state, version = r.service.read()
@@ -540,6 +550,7 @@ class _PSClient:
         client's own) and returned unchecked — the overlapped prefetch path,
         whose bytes are attributed only when the result is consumed so
         ``wire_bytes`` reads stay deterministic while a pull is in flight."""
+        # graftlint: disable=GL001(the lock IS the request/reply pairing — one in-flight exchange per connection; the server replies promptly per-op and close/shutdown unblocks a parked recv)
         with self._lock:
             _send_msg(self._sock, msg, counters)
             reply, _ = _recv_msg(self._sock, pool=self._pool,

@@ -214,7 +214,9 @@ class ParameterService:
         # under only this mutex, so readers (read/read_if_newer/read_min —
         # the transport's pull hot path) block for the brief state swap, not
         # for a whole apply program. Order: _write_mutex -> _lock, never the
-        # reverse.
+        # reverse — declared for graftlint so an inverted path fails lint
+        # (GL002) instead of deadlocking a chief under load.
+        # graftlint: lock-order=_write_mutex->_lock
         self._write_mutex = threading.Lock()
         # Generation counter: bumps on EVERY state replacement (apply, reset,
         # adopt) and is never reused, so version equality implies state
@@ -343,6 +345,7 @@ class AsyncWorker:
             # Gradient programs carry cross-replica collectives: run one at a
             # time to completion (see _collective_lock) so two workers' steps
             # can never interleave a rendezvous.
+            # graftlint: disable=GL001(this lock EXISTS to serialize execution — the PR 2 deadlock fix; holding it across the dispatch is the point)
             with r._collective_lock:
                 grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
                 jax.block_until_ready((grads, loss, aux, _ef))
@@ -483,6 +486,7 @@ class AsyncPSRunner(DistributedRunner):
         # dispatched gradient program's (see _collective_lock).
         def run(state, grads):
             with self.mesh:
+                # graftlint: disable=GL001(execution-serialization lock by design — the PS apply must not interleave its collectives with a worker grad program)
                 with self._collective_lock:
                     new_state = apply_fn(state, grads)
                     jax.block_until_ready(new_state)

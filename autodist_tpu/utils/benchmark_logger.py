@@ -83,7 +83,8 @@ class BenchmarkFileLogger(BaseBenchmarkLogger):
 def get_benchmark_logger() -> BaseBenchmarkLogger:
     """File logger when AUTODIST_BENCHMARK_LOG_DIR is set, else the base logger
     (the reference selected its sink from flags the same way)."""
-    log_dir = os.environ.get("AUTODIST_BENCHMARK_LOG_DIR", "")
+    from autodist_tpu import const
+    log_dir = const.ENV.AUTODIST_BENCHMARK_LOG_DIR.val
     if log_dir:
         return BenchmarkFileLogger(log_dir)
     return BaseBenchmarkLogger()

@@ -17,7 +17,6 @@ Peak FLOP/s comes from the device kind (bf16 peak), overridable with
 ``AUTODIST_PEAK_FLOPS`` for new hardware.
 """
 
-import os
 from typing import Optional
 
 # bf16 peak FLOP/s per chip by device_kind prefix (public spec sheets).
@@ -34,7 +33,8 @@ _PEAK_BF16 = {
 
 def device_peak_flops(device=None) -> Optional[float]:
     """Per-device bf16 peak FLOP/s, or None when unknown (e.g. CPU)."""
-    override = os.environ.get("AUTODIST_PEAK_FLOPS")
+    from autodist_tpu import const
+    override = const.ENV.AUTODIST_PEAK_FLOPS.val
     if override:
         return float(override)
     try:

@@ -50,6 +50,21 @@ python - <<'EOF'
 import autodist_tpu  # the package must import cleanly, no side effects required
 print("import autodist_tpu OK:", autodist_tpu.__name__)
 EOF
+# graftlint: the project-specific analyzer (lock-across-dispatch, lock order,
+# donation, tracer leaks, wire opcodes, env-flag registry, test-window rules
+# — docs/usage/static_analysis.md). Hard gate: NEW findings fail; the
+# committed baseline (tools/graftlint_baseline.json) grandfathers old ones.
+if ! python tools/graftlint.py --format json autodist_tpu tests examples bench.py > /tmp/graftlint.json; then
+    echo "graftlint: NEW findings — fix, or suppress with '# graftlint: disable=GLnnn(reason)':"
+    python tools/graftlint.py autodist_tpu tests examples bench.py || true
+    exit 1
+fi
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/graftlint.json"))
+print(f"graftlint OK: {d['files_checked']} files, "
+      f"{len(d['suppressed'])} suppressed, {len(d['baselined'])} baselined")
+EOF
 
 echo "=== [2/4] test suite (8-device CPU-sim mesh) ==="
 # Sharded across 4 pytest processes (tools/parallel_tests.py): the slow tail
@@ -60,7 +75,8 @@ echo "=== [2/4] test suite (8-device CPU-sim mesh) ==="
 if [[ "${AUTODIST_CI_SERIAL:-0}" == "1" ]]; then
     python -m pytest tests/ -q
 else
-    python tools/parallel_tests.py -n 4
+    # --no-lint: stage [1/4] above already gated on graftlint.
+    python tools/parallel_tests.py -n 4 --no-lint
 fi
 
 if [[ "$FAST" == "1" ]]; then

@@ -1,0 +1,636 @@
+"""graftlint (autodist_tpu.analysis) — fixture tests per check + engine.
+
+NAMED to sort inside the tier-1 alphabetical window (after test_generate,
+before test_multiprocess — the convention GL008 itself enforces). Everything
+here is pure-AST: no jax, no subprocesses, sub-second.
+
+Each GL00x check gets at least one violating and one clean fixture; the
+engine gets suppression / baseline / JSON / directive-error coverage; and a
+meta-test asserts the REPO ITSELF is lint-clean against the committed
+baseline, so a hazard regression fails tier-1, not just ci.sh's lint stage.
+"""
+
+import importlib.util
+import json
+import os
+import textwrap
+
+import pytest
+
+from autodist_tpu.analysis import core
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Fixture flag names, concatenated so GL007's literal scan (full-match on
+# AUTODIST_* string constants) does not read them as unregistered real flags
+# of THIS file.
+GOOD_FLAG = "AUTODIST_" + "GOOD"
+
+_cli_spec = importlib.util.spec_from_file_location(
+    "graftlint_cli", os.path.join(ROOT, "tools", "graftlint.py"))
+cli = importlib.util.module_from_spec(_cli_spec)
+_cli_spec.loader.exec_module(cli)
+
+
+def lint(tmp_path, source, relname="mod.py", checks=None, known_flags=None):
+    """Lint one dedented snippet written at ``tmp_path/relname``."""
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    ctx = core.Context(str(tmp_path), known_flags=known_flags)
+    return core.lint_paths([str(path)], root=str(tmp_path), checks=checks,
+                           context=ctx)
+
+
+def codes(result):
+    return [f.check for f in result.findings]
+
+
+# --------------------------------------------------------------------- GL001
+
+# The PR 2 deadlock pattern (acceptance regression): a multi-device program
+# dispatched inside an AsyncPSRunner._collective_lock-style critical section
+# — but as a NEW, unannotated site, i.e. without the reviewed serialization
+# rationale the real _collective_lock carries.
+PR2_DEADLOCK = """
+    import threading
+
+    class BadRunner:
+        def __init__(self, runner):
+            self._collective_lock = threading.Lock()
+            self._runner = runner
+
+        def step(self, state, batch):
+            with self._collective_lock:
+                new_state, loss = self._runner.run(state, batch)
+            return new_state, loss
+"""
+
+
+def test_gl001_flags_pr2_deadlock_pattern(tmp_path):
+    res = lint(tmp_path, PR2_DEADLOCK, checks=["GL001"])
+    assert codes(res) == ["GL001"]
+    (f,) = res.findings
+    assert "_collective_lock" in f.message and "run" in f.message
+    assert f.scope == "BadRunner.step"
+
+
+def test_gl001_clean_when_dispatch_outside_lock(tmp_path):
+    res = lint(tmp_path, """
+        import threading
+
+        class GoodRunner:
+            def __init__(self, runner):
+                self._lock = threading.Lock()
+                self._runner = runner
+                self._queue = []
+
+            def step(self, state, batch):
+                with self._lock:
+                    self._queue.append(batch)
+                return self._runner.run(state, batch)
+    """, checks=["GL001"])
+    assert res.ok
+
+
+def test_gl001_sees_through_local_helpers_and_jitted_names(tmp_path):
+    res = lint(tmp_path, """
+        import threading
+        import jax
+
+        _lock = threading.Lock()
+
+        def _push(sock, data):
+            sock.sendall(data)
+
+        def locked_send(sock, data):
+            with _lock:
+                _push(sock, data)
+
+        def locked_jit(lock, x):
+            f = jax.jit(lambda y: y * 2)
+            with lock:
+                return f(x)
+    """, checks=["GL001"])
+    assert codes(res) == ["GL001", "GL001"]
+    assert "via _push" in res.findings[0].message
+    assert "(jitted)" in res.findings[1].message
+
+
+def test_gl001_ignores_deferred_code_defined_under_lock(tmp_path):
+    """A callback merely DEFINED while the lock is held runs after release —
+    no held-across-dispatch hazard, no finding (GL002 likewise)."""
+    res = lint(tmp_path, """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other_lock = threading.Lock()
+                self._cbs = []
+
+            def register(self, sock):
+                with self._lock:
+                    def cb(data):
+                        sock.sendall(data)
+                        with self._other_lock:
+                            pass
+                    self._cbs.append(cb)
+    """, checks=["GL001", "GL002"])
+    assert res.ok
+
+
+def test_gl001_suppression_with_reason(tmp_path):
+    suppressed = PR2_DEADLOCK.replace(
+        "with self._collective_lock:",
+        "# graftlint: disable=GL001(serializes execution on purpose)\n"
+        "            with self._collective_lock:")
+    res = lint(tmp_path, suppressed, checks=["GL001"])
+    assert res.ok
+    [(finding, reason)] = res.suppressed
+    assert finding.check == "GL001"
+    assert reason == "serializes execution on purpose"
+
+
+# --------------------------------------------------------------------- GL002
+
+ABBA = """
+    import threading
+
+    class Service:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def forward(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def backward(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+"""
+
+
+def test_gl002_flags_inversion_against_declared_order(tmp_path):
+    res = lint(tmp_path, "# graftlint: lock-order=_a_lock->_b_lock\n"
+               + textwrap.dedent(ABBA), checks=["GL002"])
+    assert codes(res) == ["GL002"]
+    (f,) = res.findings
+    assert f.scope == "Service.backward"
+    assert "conflicting" in f.message
+
+
+def test_gl002_undeclared_nesting_is_flagged(tmp_path):
+    res = lint(tmp_path, ABBA, checks=["GL002"])
+    # Both nestings lack a declared order (and invert each other).
+    assert len(res.findings) == 2
+    assert all(f.check == "GL002" for f in res.findings)
+
+
+def test_gl002_clean_with_declared_consistent_order(tmp_path):
+    res = lint(tmp_path, """
+        # graftlint: lock-order=_write_mutex->_lock
+        import threading
+
+        class PS:
+            def __init__(self):
+                self._write_mutex = threading.Lock()
+                self._lock = threading.Condition()
+
+            def reset(self):
+                with self._write_mutex:
+                    with self._lock:
+                        self._lock.notify_all()
+    """, checks=["GL002"])
+    assert res.ok
+
+
+# --------------------------------------------------------------------- GL003
+
+def test_gl003_flags_read_after_donation(tmp_path):
+    res = lint(tmp_path, """
+        import jax
+
+        def train(state, batch):
+            step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            new_state = step(state, batch)
+            return state
+    """, checks=["GL003"])
+    assert codes(res) == ["GL003"]
+    assert "`state`" in res.findings[0].message
+
+
+def test_gl003_sees_donor_assigned_inside_a_branch(tmp_path):
+    res = lint(tmp_path, """
+        import jax
+
+        def train(state, batch, donate):
+            if donate:
+                step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+                new_state = step(state, batch)
+                return state
+            return state
+    """, checks=["GL003"])
+    assert codes(res) == ["GL003"]
+
+
+def test_gl003_clean_when_result_is_used(tmp_path):
+    res = lint(tmp_path, """
+        import jax
+
+        def train(state, batch):
+            step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            state = step(state, batch)
+            return state
+    """, checks=["GL003"])
+    assert res.ok
+
+
+# --------------------------------------------------------------------- GL004
+
+def test_gl004_flags_host_calls_and_captured_stores(tmp_path):
+    res = lint(tmp_path, """
+        import time
+        import jax
+
+        class Meter:
+            pass
+
+        meter = Meter()
+
+        @jax.jit
+        def step(x):
+            print("stepping", x)
+            meter.last = x
+            t = time.time()
+            return x * 2
+
+        @jax.jit
+        def builds_locally(y):
+            local = Meter()
+            local.value = y      # object created under trace: fine
+            return y + 1
+    """, checks=["GL004"])
+    msgs = [f.message for f in res.findings]
+    assert codes(res).count("GL004") == 3
+    assert any("`print`" in m for m in msgs)
+    assert any("meter.last" in m for m in msgs)
+    assert any("time.time" in m for m in msgs)
+    assert not any("local.value" in m for m in msgs)
+
+
+def test_gl004_clean_pure_jitted_fn(tmp_path):
+    res = lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(params, batch):
+            loss = jnp.mean((params - batch) ** 2)
+            return loss
+    """, checks=["GL004"])
+    assert res.ok
+
+
+# --------------------------------------------------------------------- GL005
+
+def test_gl005_flags_unbounded_wait_in_package_code(tmp_path):
+    res = lint(tmp_path, """
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def wait_open(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: True)
+
+            def pause(self):
+                with self._cond:
+                    self._cond.wait(timeout=None)
+    """, relname="autodist_tpu/gate.py", checks=["GL005"])
+    assert codes(res) == ["GL005", "GL005"]
+
+
+def test_gl005_clean_with_timeout_and_outside_package(tmp_path):
+    clean = """
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def wait_open(self, timeout):
+                with self._cond:
+                    return self._cond.wait_for(lambda: True, timeout)
+    """
+    assert lint(tmp_path, clean, relname="autodist_tpu/gate.py",
+                checks=["GL005"]).ok
+    unbounded_but_test_code = """
+        import threading
+        cond = threading.Condition()
+        with cond:
+            cond.wait_for(lambda: True)
+    """
+    assert lint(tmp_path, unbounded_but_test_code,
+                relname="tests/helper.py", checks=["GL005"]).ok
+
+
+# --------------------------------------------------------------------- GL006
+
+def test_gl006_flags_opcode_without_dispatch_arm(tmp_path):
+    res = lint(tmp_path, """
+        class Client:
+            def push(self, grads):
+                return self._client.call("aply", grads)
+
+            def pull(self):
+                return self._client.call("read")
+
+        def _dispatch(msg):
+            op = msg[0]
+            if op == "apply":
+                return ("ok",)
+            if op == "read":
+                return ("ok", 1)
+            return ("error", "unknown")
+    """, checks=["GL006"])
+    assert codes(res) == ["GL006"]
+    assert "'aply'" in res.findings[0].message
+
+
+def test_gl006_flags_asymmetric_codec_tags_and_unchecked_version(tmp_path):
+    res = lint(tmp_path, """
+        import struct
+
+        _HDR = struct.Struct("!Q")
+        _FRAME_VERSION = 0
+
+        def _enc(out, obj):
+            out += b"z"
+
+        def _dec(r):
+            tag = r.take(1)
+            if tag == b"y":
+                return 1
+
+        def _frame_len(header):
+            (word,) = _HDR.unpack(header)
+            if word >> 56 != _FRAME_VERSION:
+                raise ValueError(word)
+            return word
+
+        def sloppy_len(header):
+            (word,) = _HDR.unpack(header)
+            return word
+    """, checks=["GL006"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert codes(res).count("GL006") == 3
+    assert "b'z'" in msgs and "b'y'" in msgs and "sloppy_len" in msgs
+
+
+def test_gl006_clean_symmetric_protocol(tmp_path):
+    res = lint(tmp_path, """
+        class Client:
+            def push(self, grads):
+                return self._client.call("apply", grads)
+
+        def _dispatch(msg):
+            op = msg[0]
+            if op == "apply":
+                return ("ok",)
+            return ("error", "unknown")
+    """, checks=["GL006"])
+    assert res.ok
+
+
+# --------------------------------------------------------------------- GL007
+
+def test_gl007_direct_env_read_in_package_and_typo_flag(tmp_path):
+    res = lint(tmp_path, """
+        import os
+
+        good = os.environ.get("AUTODIST_GOOD")
+        typo = os.environ.get("AUTODIST_GOOOD")
+    """, relname="autodist_tpu/mod.py", checks=["GL007"],
+        known_flags={GOOD_FLAG})
+    # Two direct package reads + one unknown name.
+    assert codes(res).count("GL007") == 3
+    assert sum("unknown flag" in f.message for f in res.findings) == 1
+
+
+def test_gl007_known_flag_outside_package_is_clean(tmp_path):
+    res = lint(tmp_path, """
+        import os
+
+        flag = os.environ.get("AUTODIST_GOOD", "")
+        env = dict(os.environ)
+        env["AUTODIST_GOOD"] = "1"
+    """, relname="tests/helper.py", checks=["GL007"],
+        known_flags={GOOD_FLAG})
+    assert res.ok
+
+
+def test_known_flags_parsed_from_real_const_py():
+    flags = core.Context(ROOT).known_flags()
+    assert flags is not None
+    assert "AUTODIST_PS_OVERLAP" in flags
+    assert "AUTODIST_MATRIX_PROCS" in flags
+
+
+# --------------------------------------------------------------------- GL008
+
+def test_gl008_unmarked_subprocess_file_inside_window(tmp_path):
+    res = lint(tmp_path, """
+        import subprocess
+
+        def test_spawns():
+            subprocess.run(["echo", "hi"], check=True)
+    """, relname="tests/test_aaa.py", checks=["GL008"])
+    assert codes(res) == ["GL008"]
+    assert "tier-1 window" in res.findings[0].message
+
+
+def test_gl008_clean_when_marked_slow_or_after_edge(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.pytest.ini_options]\nmarkers = ["slow: slow tests"]\n')
+    marked = """
+        import subprocess
+        import pytest
+
+        @pytest.mark.slow
+        def test_spawns():
+            subprocess.run(["echo", "hi"], check=True)
+    """
+    assert lint(tmp_path, marked, relname="tests/test_aaa.py",
+                checks=["GL008"]).ok
+    after_edge = """
+        import subprocess
+
+        def test_spawns():
+            subprocess.run(["echo", "hi"], check=True)
+    """
+    assert lint(tmp_path, after_edge, relname="tests/test_zz_dist.py",
+                checks=["GL008"]).ok
+
+
+def test_gl008_detects_mp_env_harness_import_forms(tmp_path):
+    res = lint(tmp_path, """
+        from tests.mp_env import mp_env
+
+        def test_cluster():
+            mp_env(2)
+    """, relname="tests/test_bbb.py", checks=["GL008"])
+    assert codes(res) == ["GL008"]
+    assert "mp_env" in res.findings[0].message
+
+
+def test_gl008_bad_filename_and_unregistered_marker(tmp_path):
+    res = lint(tmp_path, """
+        import pytest
+
+        @pytest.mark.slow
+        def test_x():
+            pass
+    """, relname="tests/test_CamelCase.py", checks=["GL008"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert codes(res).count("GL008") == 2
+    assert "does not match" in msgs and "not registered" in msgs
+
+
+# ----------------------------------------------------------- engine behavior
+
+def test_reasonless_suppression_is_a_gl000_finding(tmp_path):
+    res = lint(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+
+        def locked_send(sock, data):
+            with _lock:  # graftlint: disable=GL001
+                sock.sendall(data)
+    """, checks=["GL001"])
+    assert sorted(codes(res)) == ["GL000", "GL001"]  # suppression rejected
+    assert "no reason" in next(
+        f.message for f in res.findings if f.check == "GL000")
+
+
+def test_unknown_directive_is_flagged(tmp_path):
+    res = lint(tmp_path, "# graftlint: disbale=GL001(oops)\nx = 1\n",
+               checks=["GL001"])
+    assert codes(res) == ["GL000"]
+
+
+def test_syntax_error_is_reported_not_crashed(tmp_path):
+    res = lint(tmp_path, "def broken(:\n", checks=["GL001"])
+    assert codes(res) == ["GL000"]
+    assert "does not parse" in res.findings[0].message
+
+
+def test_baseline_grandfathers_old_findings_only(tmp_path):
+    res = lint(tmp_path, PR2_DEADLOCK, relname="old.py", checks=["GL001"])
+    assert len(res.findings) == 1
+    baseline_path = tmp_path / "baseline.json"
+    core.write_baseline(str(baseline_path), res.findings)
+    baseline = core.load_baseline(str(baseline_path))
+
+    # Same findings + baseline => clean, reported as baselined.
+    ctx = core.Context(str(tmp_path))
+    res2 = core.lint_paths([str(tmp_path / "old.py")], root=str(tmp_path),
+                           baseline=baseline, checks=["GL001"], context=ctx)
+    assert res2.ok and len(res2.baselined) == 1
+
+    # A NEW violation in another file still fails.
+    (tmp_path / "new.py").write_text(textwrap.dedent(PR2_DEADLOCK))
+    res3 = core.lint_paths([str(tmp_path)], root=str(tmp_path),
+                           baseline=baseline, checks=["GL001"], context=ctx)
+    assert [f.path for f in res3.findings] == ["new.py"]
+
+    # Fixing the old finding surfaces the stale baseline entry.
+    (tmp_path / "old.py").write_text("x = 1\n")
+    res4 = core.lint_paths([str(tmp_path / "old.py")], root=str(tmp_path),
+                           baseline=baseline, checks=["GL001"], context=ctx)
+    assert res4.ok and len(res4.stale_baseline) == 1
+
+
+def test_baseline_never_grandfathers_gl000(tmp_path):
+    """--write-baseline must not become a side door around the 'GL000
+    cannot be suppressed' invariant: meta-findings (reasonless directives,
+    parse errors) are excluded from writing AND from matching."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        _lock = threading.Lock()
+
+        def locked_send(sock, data):
+            with _lock:  # graftlint: disable=GL001
+                sock.sendall(data)
+    """))
+    ctx = core.Context(str(tmp_path))
+    res = core.lint_paths([str(bad)], root=str(tmp_path), checks=["GL001"],
+                          context=ctx)
+    assert sorted(codes(res)) == ["GL000", "GL001"]
+    baseline_path = tmp_path / "baseline.json"
+    core.write_baseline(str(baseline_path), res.findings)
+    baseline = core.load_baseline(str(baseline_path))
+    assert all("GL000" not in fp.split("|")[0] for fp in baseline)
+    # Even a hand-edited baseline containing the GL000 fingerprint is inert.
+    gl000 = next(f for f in res.findings if f.check == "GL000")
+    res2 = core.lint_paths([str(bad)], root=str(tmp_path), checks=["GL001"],
+                           baseline=baseline | {gl000.fingerprint},
+                           context=ctx)
+    assert "GL000" in codes(res2)
+
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(PR2_DEADLOCK))
+    rc = cli.main(["--format", "json", "--no-baseline", "--check", "GL001",
+                   str(bad)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["ok"] is False
+    assert payload["findings"][0]["check"] == "GL001"
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    rc = cli.main(["--format", "json", "--no-baseline", str(good)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] is True
+
+
+def test_nonexistent_path_is_an_error_not_a_green_gate(tmp_path, capsys):
+    """A typo'd/renamed CI path must fail loudly — linting 0 files and
+    exiting 0 would green-light every hazard class the gate exists for."""
+    with pytest.raises(FileNotFoundError):
+        core.lint_paths([str(tmp_path / "nope")], root=str(tmp_path),
+                        context=core.Context(str(tmp_path)))
+    assert cli.main([str(tmp_path / "nope_dir")]) == 2
+    capsys.readouterr()
+
+
+def test_cli_explain_documents_real_bug_provenance(capsys):
+    assert cli.main(["--explain", "GL001"]) == 0
+    out = capsys.readouterr().out
+    assert "PR 2" in out and "rendezvous" in out
+    assert cli.main(["--explain", "GL999"]) == 2
+
+
+def test_all_eight_checks_are_registered():
+    ids = set(core.all_checks())
+    assert ids == {f"GL00{i}" for i in range(1, 9)}
+
+
+# ------------------------------------------------------------ self-cleanness
+
+def test_repo_is_lint_clean_against_committed_baseline(capsys):
+    """The acceptance gate, in-suite: a reintroduced hazard (or a stale
+    suppression/baseline edit) fails tier-1 here, not just ci.sh's lint
+    stage. Runs the real CLI with the real committed baseline."""
+    rc = cli.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, f"graftlint found new findings:\n{out}"

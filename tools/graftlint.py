@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""graftlint — project-specific static analysis for autodist_tpu.
+
+Usage:
+    python tools/graftlint.py [paths...]           # text output, baseline on
+    python tools/graftlint.py --format json ...    # machine-readable (CI)
+    python tools/graftlint.py --explain GL001      # why a check exists
+    python tools/graftlint.py --list-checks
+    python tools/graftlint.py --write-baseline ... # re-grandfather findings
+
+Default paths mirror the CI gate: autodist_tpu tests examples bench.py.
+Exit status: 0 = clean (only suppressed/baselined findings), 1 = new
+findings, 2 = usage error. Findings are suppressed inline with
+``# graftlint: disable=GLnnn(reason)`` — the reason is mandatory — and
+grandfathered via tools/graftlint_baseline.json (new findings fail, old ones
+don't). See docs/usage/static_analysis.md for the check catalog.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from autodist_tpu.analysis import core  # noqa: E402
+
+DEFAULT_PATHS = ["autodist_tpu", "tests", "examples", "bench.py"]
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "graftlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as failures too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0")
+    ap.add_argument("--explain", metavar="GLnnn",
+                    help="print a check's rationale and exit")
+    ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--check", action="append", metavar="GLnnn",
+                    help="run only these checks (repeatable)")
+    args = ap.parse_args(argv)
+
+    checks = core.all_checks()
+    if args.list_checks:
+        for cid in sorted(checks):
+            print(f"{cid}  {checks[cid].title}")
+        return 0
+    if args.explain:
+        check = checks.get(args.explain)
+        if check is None:
+            print(f"unknown check {args.explain!r}; known: "
+                  f"{', '.join(sorted(checks))}", file=sys.stderr)
+            return 2
+        print(f"{check.id} — {check.title}\n")
+        print((check.doc or "(no documentation)").strip())
+        return 0
+    if args.check:
+        unknown = [c for c in args.check if c not in checks]
+        if unknown:
+            print(f"unknown check(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or DEFAULT_PATHS
+    baseline = set() if (args.no_baseline or args.write_baseline) \
+        else core.load_baseline(args.baseline)
+    try:
+        result = core.lint_paths(paths, root=ROOT, baseline=baseline,
+                                 checks=args.check)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        core.write_baseline(args.baseline, result.findings)
+        print(f"graftlint: wrote {len(result.findings)} grandfathered "
+              f"finding(s) to {os.path.relpath(args.baseline, ROOT)}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "files_checked": result.files_checked,
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": [f.to_json() for f in result.baselined],
+            "suppressed": [{"finding": f.to_json(), "reason": r}
+                           for f, r in result.suppressed],
+            "stale_baseline": result.stale_baseline,
+            "ok": result.ok,
+        }, indent=1))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())
+    tail = (f"graftlint: {len(result.findings)} new finding(s) over "
+            f"{result.files_checked} file(s)"
+            f" ({len(result.suppressed)} suppressed, "
+            f"{len(result.baselined)} baselined)")
+    if result.stale_baseline:
+        tail += (f"; {len(result.stale_baseline)} stale baseline entr"
+                 f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+                 f"(fixed findings — prune with --write-baseline)")
+    print(tail)
+    if result.findings:
+        print("explain a check: python tools/graftlint.py --explain GLnnn; "
+              "suppress with `# graftlint: disable=GLnnn(reason)`")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

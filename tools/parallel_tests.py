@@ -43,12 +43,33 @@ def shard_files(n: int):
     return [s for s in shards if s]
 
 
+def run_lint():
+    """graftlint as a distinct pre-stage: static-analysis findings are NOT
+    test failures — they fail with their own banner and exit code (2) so a
+    red run is immediately attributable. Fast (<30s; pure AST)."""
+    print("lint: graftlint (static analysis) ...")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "graftlint.py")],
+        cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    if proc.returncode == 0:
+        print(f"lint: OK ({proc.stdout.strip().splitlines()[-1]})")
+        return True
+    print("lint: FAILED — graftlint findings (static analysis, not test "
+          "failures):")
+    print(proc.stdout.rstrip())
+    return False
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("-n", type=int, default=4, help="shard count")
+    parser.add_argument("--no-lint", action="store_true",
+                        help="skip the graftlint pre-stage (ci.sh runs it "
+                             "in its own lint stage)")
     parser.add_argument("rest", nargs="*", help="extra pytest args (after --)")
     args = parser.parse_args(argv)
 
+    lint_ok = True if args.no_lint else run_lint()
     shards = shard_files(args.n)
     t0 = time.time()
     procs = []
@@ -77,7 +98,12 @@ def main(argv=None):
                             or "ERROR" in line) or "\n".join(tail[-15:]))
     print(f"total wall clock: {time.time() - t0:.0f}s across "
           f"{len(shards)} shards")
-    return 1 if failed else 0
+    if not lint_ok:
+        print("lint: FAILED (graftlint — rerun: python tools/graftlint.py; "
+              "distinct from the test results above)")
+    if failed:
+        return 1      # test failures (lint status printed separately)
+    return 2 if not lint_ok else 0
 
 
 if __name__ == "__main__":
