@@ -62,6 +62,8 @@ KNOWN_FLAGS = {
     "AUTODIST_NATIVE_TRANSPORT": "0/false disables the native send/recv lib",
     "AUTODIST_PEAK_FLOPS": "per-device peak FLOP/s override for MFU math",
     "AUTODIST_BENCHMARK_LOG_DIR": "benchmark metric file sink directory",
+    "AUTODIST_TELEMETRY": "enable host span tracing + metrics registry",
+    "AUTODIST_TELEMETRY_RING": "span ring-buffer capacity (spans retained)",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -121,6 +123,10 @@ _ENV_DEFAULTS = {
     "AUTODIST_PEAK_FLOPS": "",
     # Directory for benchmark metric files (utils/benchmark_logger.py).
     "AUTODIST_BENCHMARK_LOG_DIR": "",
+    # Host-side telemetry (autodist_tpu/telemetry): span recording + registry
+    # mirroring on/off, and the span ring buffer's capacity.
+    "AUTODIST_TELEMETRY": False,
+    "AUTODIST_TELEMETRY_RING": 65536,
 }
 
 class ENV(enum.Enum):
@@ -145,6 +151,8 @@ class ENV(enum.Enum):
     AUTODIST_NATIVE_TRANSPORT = "AUTODIST_NATIVE_TRANSPORT"
     AUTODIST_PEAK_FLOPS = "AUTODIST_PEAK_FLOPS"
     AUTODIST_BENCHMARK_LOG_DIR = "AUTODIST_BENCHMARK_LOG_DIR"
+    AUTODIST_TELEMETRY = "AUTODIST_TELEMETRY"
+    AUTODIST_TELEMETRY_RING = "AUTODIST_TELEMETRY_RING"
 
     @property
     def val(self):

@@ -55,6 +55,7 @@ from typing import Any, List, Optional, Tuple, Union
 import jax
 import numpy as np
 
+from autodist_tpu import telemetry
 from autodist_tpu.parallel import wire
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import WireCounters
@@ -225,6 +226,22 @@ def _frame_len(header: bytes) -> int:
     return word & _FRAME_LEN_MAX
 
 
+_RECVBUF_TEL = None
+
+
+def _recvbuf_counters():
+    """Cached (fresh, recycled) registry counters, ``None`` while telemetry
+    is disabled — one enabled-check per message instead of a registry
+    get-or-create lookup (same pattern as ``metrics._wire_registry``)."""
+    if not telemetry.enabled():
+        return None
+    global _RECVBUF_TEL
+    if _RECVBUF_TEL is None:
+        _RECVBUF_TEL = (telemetry.counter("ps.recvbuf.fresh"),
+                        telemetry.counter("ps.recvbuf.recycled"))
+    return _RECVBUF_TEL
+
+
 class _RecvBuffer:
     """Per-connection recycled receive buffer for the zero-copy plane.
 
@@ -236,18 +253,34 @@ class _RecvBuffer:
     client's conditional-pull cache, or jax buffers still pinned by an
     in-flight dispatch) silently gets a FRESH buffer instead of having its
     data overwritten. Consume-then-drop callers pay zero copies; holders pay
-    one allocation, never corruption."""
+    one allocation, never corruption.
 
-    __slots__ = ("_buf",)
+    ``fresh_allocs``/``recycles`` count the two outcomes (mirrored into the
+    telemetry registry as ``ps.recvbuf.fresh``/``ps.recvbuf.recycled`` when
+    enabled): a recycle ratio near zero on a hot connection means some
+    consumer is holding decoded trees and the zero-copy receive path is
+    paying an allocation per message."""
+
+    __slots__ = ("_buf", "fresh_allocs", "recycles")
     _MIN_BYTES = 1 << 16
 
     def __init__(self):
         self._buf: Optional[bytearray] = None
+        self.fresh_allocs = 0
+        self.recycles = 0
 
     def take(self, n: int) -> memoryview:
+        tel = _recvbuf_counters()
         if (self._buf is None or len(self._buf) < n
                 or sys.getrefcount(self._buf) != 2):
             self._buf = bytearray(max(n, self._MIN_BYTES))
+            self.fresh_allocs += 1
+            if tel is not None:
+                tel[0].inc()
+        else:
+            self.recycles += 1
+            if tel is not None:
+                tel[1].inc()
         return memoryview(self._buf)[:n]
 
 
@@ -299,6 +332,18 @@ def _to_host(tree: PyTree) -> PyTree:
     return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
+class _WorkerStats:
+    """Server-side per-worker accounting: the wire traffic of every
+    connection bound to one worker id (``mirror=False`` — the server's
+    aggregate ``PSServer.wire`` already mirrors these bytes into the
+    telemetry registry, and one byte must not be registry-counted twice)."""
+
+    __slots__ = ("wire",)
+
+    def __init__(self):
+        self.wire = WireCounters(mirror=False)
+
+
 class PSServer:
     """Serve a chief AsyncPSRunner's service + controller to remote workers.
 
@@ -320,6 +365,12 @@ class PSServer:
         # handled (payload bytes, message counts, encode/decode time) —
         # surfaced in the async-PS log line and summarized at close().
         self.wire = WireCounters()
+        # Per-worker breakdown of the same traffic, keyed by the worker id a
+        # connection binds to (gate/register messages); shipped over the
+        # `stats` opcode and printed at close() next to each worker's
+        # staleness histogram.
+        self._worker_stats: dict = {}
+        self._worker_stats_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -340,8 +391,8 @@ class PSServer:
                 pool = _RecvBuffer()
                 try:
                     while True:
-                        msg, _ = _recv_msg(self.request, pool=pool,
-                                           counters=outer.wire)
+                        msg, nrecv = _recv_msg(self.request, pool=pool,
+                                               counters=outer.wire)
                         reply = outer._dispatch(msg)
                         is_protocol = isinstance(msg, tuple) and bool(msg)
                         op = msg[0] if is_protocol else "<malformed>"
@@ -386,8 +437,15 @@ class PSServer:
                             # allocations, whose id only the reply knows).
                             self.worker_id = reply[1]
                             self.worker_gen = reply[2]
-                        outer.wire.add_sent(_send_payload(self.request,
-                                                          payload), enc_s)
+                        nsent = _send_payload(self.request, payload)
+                        outer.wire.add_sent(nsent, enc_s)
+                        if self.worker_id is not None:
+                            # Once the connection is bound to a worker, its
+                            # traffic also lands in that worker's breakdown
+                            # (the codec-time split stays aggregate-only).
+                            ws = outer._stats_for(self.worker_id)
+                            ws.wire.add_received(nrecv)
+                            ws.wire.add_sent(nsent)
                         # Drop this message's decoded tree (it aliases the
                         # recv buffer) BEFORE the next recv, or the loop
                         # variable itself would pin the buffer and defeat
@@ -433,6 +491,30 @@ class PSServer:
     @property
     def address(self) -> Tuple[str, int]:
         return self._server.server_address
+
+    def _stats_for(self, worker_id) -> _WorkerStats:
+        with self._worker_stats_lock:
+            ws = self._worker_stats.get(worker_id)
+            if ws is None:
+                ws = self._worker_stats[worker_id] = _WorkerStats()
+            return ws
+
+    def stats_snapshot(self) -> dict:
+        """The server's observability snapshot, wire-encodable (the ``stats``
+        opcode's reply): the process-global telemetry registry, the server's
+        aggregate wire counters, and a per-worker breakdown of wire traffic
+        plus staleness-lag histograms from the gate."""
+        with self._worker_stats_lock:
+            ws_items = sorted(self._worker_stats.items())
+        per_worker: dict = {wid: {"wire": ws.wire.snapshot()}
+                            for wid, ws in ws_items}
+        controller = getattr(self._runner, "controller", None)
+        if controller is not None:
+            for wid, snap in controller.staleness_snapshot().items():
+                per_worker.setdefault(wid, {})["staleness"] = snap
+        return {"registry": telemetry.snapshot(),
+                "wire": self.wire.snapshot(),
+                "per_worker": per_worker}
 
     def _dispatch(self, msg):
         # The wire codec's vocabulary is wider than the protocol's: a peer
@@ -497,6 +579,11 @@ class PSServer:
                 return ("ok", worker.worker_id, gen)
             if op == "version":
                 return ("ok", r.service.version)
+            if op == "stats":
+                # Cross-worker stats plane: ship this process's registry
+                # snapshot + per-worker wire/staleness breakdown to whoever
+                # asks (RemotePSWorker.stats(), dashboards, tests).
+                return ("ok", self.stats_snapshot())
             return ("error", "PSClientError", f"unknown op {op!r}")
         except Exception as e:  # ship the failure to the worker, keep serving
             return ("error", type(e).__name__, str(e))
@@ -505,7 +592,27 @@ class PSServer:
         self._server.shutdown()
         self._server.server_close()
         if self.wire.msgs_received:
+            # Aggregate first, then one line per worker: wire traffic next to
+            # the staleness-lag distribution its gate entries observed, so a
+            # skewed worker (all lag at the bound, or 10x the bytes) is
+            # visible in the close summary without grepping its own log.
             logging.info("PSServer closed: %s", self.wire.format_line())
+            controller = getattr(self._runner, "controller", None)
+            stal = controller.staleness_histograms() \
+                if controller is not None else {}
+            with self._worker_stats_lock:
+                ws_items = dict(self._worker_stats)
+            for wid in sorted(set(ws_items) | set(stal), key=str):
+                parts = []
+                ws = ws_items.get(wid)
+                if ws is not None:
+                    parts.append(ws.wire.format_line())
+                hist = stal.get(wid)
+                if hist is not None and hist.count:
+                    parts.append(f"staleness {hist.format_compact()}")
+                if parts:
+                    logging.info("PSServer closed:   worker %s: %s",
+                                 wid, " | ".join(parts))
 
 
 class PSClientError(RuntimeError):
@@ -694,34 +801,41 @@ class RemotePSWorker:
 
         def run():
             try:
-                if use_read_min:
-                    reply = client.call_raw(
-                        ("read_min", have + 1, have, self.PREFETCH_TIMEOUT),
-                        pf.counters)
-                    if (reply[0] == "error" and len(reply) > 2
-                            and "unknown op" in str(reply[2])):
-                        # Pre-read_min server: degrade to a plain conditional
-                        # read for this and every later prefetch. ONLY the
-                        # unknown-op reply downgrades — any other server-side
-                        # error is transient (this prefetch is simply
-                        # discarded at join) and must not cost the overlap
-                        # for the worker's whole life.
-                        self._server_has_read_min = False
-                        logging.info(
-                            "PS overlap: server has no read_min op; "
-                            "prefetching with plain conditional reads")
-                        reply = client.call_raw(("read_if_newer", have),
-                                                pf.counters)
-                else:
-                    reply = client.call_raw(("read_if_newer", have),
-                                            pf.counters)
-                pf.result = reply
+                with telemetry.span("ps.prefetch", worker=self.worker_id):
+                    self._prefetch_exchange(pf, client, have, use_read_min)
             except BaseException as e:  # surfaced (or discarded) at join
                 pf.error = e
         pf.thread = threading.Thread(target=run, daemon=True,
                                      name="ps-pull-prefetch")
         pf.thread.start()
         self._prefetch = pf
+
+    def _prefetch_exchange(self, pf: _Prefetch, client: _PSClient, have: int,
+                           use_read_min: bool):
+        """The background pull's request/reply exchange (the body of the
+        prefetch thread, spanned as ``ps.prefetch``)."""
+        if use_read_min:
+            reply = client.call_raw(
+                ("read_min", have + 1, have, self.PREFETCH_TIMEOUT),
+                pf.counters)
+            if (reply[0] == "error" and len(reply) > 2
+                    and "unknown op" in str(reply[2])):
+                # Pre-read_min server: degrade to a plain conditional
+                # read for this and every later prefetch. ONLY the
+                # unknown-op reply downgrades — any other server-side
+                # error is transient (this prefetch is simply
+                # discarded at join) and must not cost the overlap
+                # for the worker's whole life.
+                self._server_has_read_min = False
+                logging.info(
+                    "PS overlap: server has no read_min op; "
+                    "prefetching with plain conditional reads")
+                reply = client.call_raw(("read_if_newer", have),
+                                        pf.counters)
+        else:
+            reply = client.call_raw(("read_if_newer", have),
+                                    pf.counters)
+        pf.result = reply
 
     def _take_prefetch(self):
         """Join the in-flight pull; returns ``(params, ef_state, version)`` or
@@ -773,23 +887,36 @@ class RemotePSWorker:
 
     def step(self, batch: PyTree, timeout: Optional[float] = None):
         r = self._runner
-        self._client.call("start_step", self.worker_id, timeout)
-        params, ef_state, _ = self._pull()
-        sharded = r.shard_batch(batch)
-        with r.mesh:
-            grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
-        grads = _to_host(grads)
+        with telemetry.span("ps.gate", worker=self.worker_id):
+            self._client.call("start_step", self.worker_id, timeout)
+        with telemetry.span("ps.pull", worker=self.worker_id):
+            params, ef_state, _ = self._pull()
+        with telemetry.span("ps.shard"):
+            sharded = r.shard_batch(batch)
+        with telemetry.span("ps.grad"):
+            with r.mesh:
+                grads, loss, aux, _ef = r.grad_fn(params, sharded, ef_state)
+            grads = _to_host(grads)
         # Overlap: next step's parameter download streams on the second
         # socket while this one pushes the gradients and runs the
         # finish/start gate round trips. The gate ordering is unchanged —
         # finish_step goes out only after the apply is acknowledged.
         self._start_prefetch()
-        self._client.call("apply", grads)
-        self._client.call("finish_step", self.worker_id)
+        with telemetry.span("ps.push", worker=self.worker_id):
+            self._client.call("apply", grads)
+            self._client.call("finish_step", self.worker_id)
         self.steps_completed += 1
         if r.has_aux:
             return loss, aux
         return loss
+
+    def stats(self) -> dict:
+        """Pull the chief's stats snapshot over the transport: the server
+        process's telemetry-registry snapshot, its aggregate wire counters,
+        and the per-worker wire/staleness breakdown
+        (:meth:`PSServer.stats_snapshot`) — remote observability without
+        grepping the chief's log."""
+        return self._client.call("stats")[0]
 
     @property
     def version(self) -> int:

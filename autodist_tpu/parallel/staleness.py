@@ -27,11 +27,13 @@ dispatch loop** (SURVEY.md §7.3 hard part #1):
 
 import math
 import threading
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 
+from autodist_tpu import telemetry
 from autodist_tpu.runner import DistributedRunner, TrainState
+from autodist_tpu.telemetry.metrics import COUNT_BUCKETS, Histogram
 from autodist_tpu.utils import logging
 
 PyTree = Any
@@ -39,6 +41,21 @@ PyTree = Any
 
 class StalenessTimeout(TimeoutError):
     """A gated worker step did not become runnable within the timeout."""
+
+
+_STALENESS_TEL = None
+
+
+def _staleness_registry_hist():
+    """Cached process-global ``ps.staleness`` registry histogram, ``None``
+    while telemetry is disabled — one enabled-check per gate entry instead
+    of a registry get-or-create lookup."""
+    if not telemetry.enabled():
+        return None
+    global _STALENESS_TEL
+    if _STALENESS_TEL is None:
+        _STALENESS_TEL = telemetry.histogram("ps.staleness", COUNT_BUCKETS)
+    return _STALENESS_TEL
 
 
 # Largest jump past the current gate size one register() may request; bounds
@@ -63,6 +80,12 @@ class StalenessController:
         self._bound = staleness if staleness > 0 else math.inf
         self._steps = [0] * num_workers
         self._retired = set()
+        # Per-worker staleness-lag distribution, observed at every gate entry
+        # (how many steps ahead of the slowest live worker each start_step
+        # found this worker). Feeds the PS `stats` opcode and the per-worker
+        # `PSServer closed:` breakdown; always recorded — a dict lookup and a
+        # bisect per gate entry, far off any hot path.
+        self._lag_hists: Dict[int, Histogram] = {}
         # Per-slot generation, bumped by register(): lets a disconnect handler
         # that observed an OLD occupant of a slot retire conditionally, so a
         # stale socket's death can never retire the live replacement.
@@ -179,10 +202,24 @@ class StalenessController:
         failure mode debuggable).
         """
         with self._cond:
-            if not self._cond.wait_for(lambda: self._runnable(worker_id), timeout):
-                raise StalenessTimeout(
-                    f"worker {worker_id} at step {self._steps[worker_id]} still "
-                    f">= {self._bound} ahead of the slowest worker after {timeout}s")
+            live = [s for i, s in enumerate(self._steps)
+                    if i not in self._retired]
+            lag = self._steps[worker_id] - min(live) if live else 0
+            hist = self._lag_hists.get(worker_id)
+            if hist is None:
+                hist = self._lag_hists[worker_id] = Histogram(
+                    f"ps.staleness.worker{worker_id}", COUNT_BUCKETS)
+            hist.observe(lag)
+            tel = _staleness_registry_hist()
+            if tel is not None:
+                tel.observe(lag)
+            with telemetry.span("ps.gate_wait", worker=worker_id):
+                if not self._cond.wait_for(lambda: self._runnable(worker_id),
+                                           timeout):
+                    raise StalenessTimeout(
+                        f"worker {worker_id} at step {self._steps[worker_id]} "
+                        f"still >= {self._bound} ahead of the slowest worker "
+                        f"after {timeout}s")
             return self._generation.get(worker_id, 0)
 
     def finish_step(self, worker_id: int) -> int:
@@ -192,6 +229,19 @@ class StalenessController:
             self._steps[worker_id] += 1
             self._cond.notify_all()
             return self._generation.get(worker_id, 0)
+
+    def staleness_histograms(self) -> Dict[int, Histogram]:
+        """Per-worker gate-entry lag histograms (live objects; the PSServer
+        close summary formats them)."""
+        with self._cond:
+            return dict(sorted(self._lag_hists.items()))
+
+    def staleness_snapshot(self) -> Dict[int, Dict]:
+        """Wire-encodable per-worker lag snapshots ``{worker_id: hist-dict}``
+        — the staleness half of the ``stats`` opcode's per-worker payload."""
+        with self._cond:
+            hists = dict(self._lag_hists)
+        return {wid: h.snapshot() for wid, h in sorted(hists.items())}
 
 
 class ParameterService:
@@ -290,7 +340,8 @@ class ParameterService:
         snapshotting the pre-apply state (exactly what they would have seen
         mid-apply anyway) instead of stalling behind a whole apply program."""
         with self._write_mutex:
-            new_state = self._apply_fn(self._state, grads)
+            with telemetry.span("ps.apply"):
+                new_state = self._apply_fn(self._state, grads)
             with self._lock:
                 self._state = new_state
                 self._version += 1

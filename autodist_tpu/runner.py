@@ -22,6 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from autodist_tpu import telemetry
 from autodist_tpu.model_spec import ModelSpec
 from autodist_tpu.parallel import synchronization
 from autodist_tpu.parallel.mesh import build_mesh
@@ -564,11 +565,18 @@ class DistributedRunner:
         first_build = step_fn is None
         if first_build:
             step_fn = self._build_step(fetches)
-        sharded = self.shard_batch(batch)
+        with telemetry.span("runner.shard_batch"):
+            sharded = self.shard_batch(batch)
         if first_build and not self._step_fns.keys() - {fetches}:
             self._maybe_dump_graphs(state, sharded, step_fn)
-        with self.mesh:
-            new_state, (loss, aux, fetched) = step_fn(state, sharded)
+        # The dispatch span closes when the program is ENQUEUED (dispatch is
+        # asynchronous); the wait for results shows up in the caller's
+        # readback span (metrics._sync / device_get), and device execution in
+        # the jax.profiler trace. A long dispatch span means compilation or a
+        # full dispatch queue.
+        with telemetry.span("runner.run.dispatch"):
+            with self.mesh:
+                new_state, (loss, aux, fetched) = step_fn(state, sharded)
         default = (loss, aux) if self._has_aux else loss
         if fetches is not None:
             return new_state, (default, fetched)
@@ -595,13 +603,17 @@ class DistributedRunner:
                 f"use run() (or train(..., unroll=1))")
         if self._state_shardings is None:
             raise RuntimeError("Call init(params) before run_many()")
-        block = batches if isinstance(batches, BatchBlock) \
-            else self.shard_block(batches)
+        if isinstance(batches, BatchBlock):
+            block = batches
+        else:
+            with telemetry.span("runner.shard_block"):
+                block = self.shard_block(batches)
         many_fn = self._many_fns.get(fetches)
         if many_fn is None:
             many_fn = self._build_many(fetches)
-        with self.mesh:
-            new_state, (losses, auxes, fetched) = many_fn(state, block.tree)
+        with telemetry.span("runner.run_many.dispatch", steps=block.length):
+            with self.mesh:
+                new_state, (losses, auxes, fetched) = many_fn(state, block.tree)
         default = (losses, auxes) if self._has_aux else losses
         if fetches is not None:
             return new_state, (default, fetched)

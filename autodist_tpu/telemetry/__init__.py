@@ -1,0 +1,37 @@
+"""Unified runtime telemetry: host-side span tracing, a process-global
+metrics registry, and the exporters feeding the cross-worker stats plane.
+
+Three planes, one subsystem (docs/usage/observability.md):
+
+- **Spans** (:mod:`autodist_tpu.telemetry.spans`) — ``telemetry.span("name")``
+  context manager / ``@telemetry.traced()`` decorator recording a host
+  timeline into a bounded ring buffer; ``export_chrome_trace(path)`` writes
+  Perfetto-loadable Chrome trace-event JSON.
+- **Metrics** (:mod:`autodist_tpu.telemetry.metrics`) — named
+  Counter/Gauge/Histogram instruments with a deterministic, wire-encodable
+  ``snapshot()``; ``emit_metrics()`` rides the benchmark-logger JSONL sink.
+- **Stats plane** — the PS transport's ``stats`` opcode ships a remote
+  process's snapshot to whoever asks
+  (:meth:`autodist_tpu.parallel.ps_transport.RemotePSWorker.stats`).
+
+Everything is OFF by default; ``AUTODIST_TELEMETRY=1`` (or
+:func:`telemetry.enable`) turns recording on. Disabled-mode instrumentation
+costs one attribute check per span (gated in ``bench.py
+--telemetry-overhead``).
+"""
+
+from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
+                                           export_chrome_trace)
+from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                            Registry, counter, gauge,
+                                            histogram, registry, snapshot)
+from autodist_tpu.telemetry.spans import (clear, disable, enable, enabled,
+                                          snapshot_spans, span, traced)
+
+__all__ = [
+    "span", "traced", "enable", "disable", "enabled", "clear",
+    "snapshot_spans",
+    "Counter", "Gauge", "Histogram", "Registry",
+    "counter", "gauge", "histogram", "registry", "snapshot",
+    "export_chrome_trace", "chrome_trace_events", "emit_metrics",
+]

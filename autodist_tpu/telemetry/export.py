@@ -1,0 +1,95 @@
+"""Telemetry exporters: Chrome trace-event JSON and benchmark-logger JSONL.
+
+Two sinks for the two telemetry planes:
+
+- :func:`export_chrome_trace` writes the span ring buffer as a Chrome
+  trace-event file (the ``{"traceEvents": [...]}`` object form) that loads in
+  ui.perfetto.dev or ``chrome://tracing`` — alongside a ``jax.profiler``
+  device trace for a host+device overlay (``utils/tracing.trace`` with
+  ``with_host_spans=True`` writes both; see docs/usage/observability.md).
+- :func:`emit_metrics` writes the metrics-registry snapshot as JSONL metric
+  rows through the existing :mod:`autodist_tpu.utils.benchmark_logger` file
+  sink (one ``metric.log`` line per instrument), so registry metrics land in
+  the same file scrapers already parse.
+"""
+
+import json
+from typing import Optional
+
+from autodist_tpu.telemetry import metrics as _metrics
+from autodist_tpu.telemetry import spans as _spans
+from autodist_tpu.utils import logging
+
+__all__ = ["export_chrome_trace", "emit_metrics"]
+
+
+def chrome_trace_events(since_ns=None) -> list:
+    """The recorded spans as a list of Chrome trace-event dicts: one ``"M"``
+    thread_name metadata event per recorded thread, then one ``"X"``
+    (complete) event per span with microsecond ``ts``/``dur`` relative to the
+    ring's epoch. ``since_ns`` (a ``time.perf_counter_ns`` stamp) keeps only
+    spans that started at/after it — the traced-window filter."""
+    pid, epoch_ns, recorded, thread_names = _spans._export_state(since_ns)
+    events = []
+    for tid, name in sorted(thread_names.items()):
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": name}})
+    for name, tid, t0_ns, dur_ns, args in recorded:
+        events.append({
+            "name": name,
+            "ph": "X",
+            "cat": "host",
+            "ts": (t0_ns - epoch_ns) / 1e3,   # trace-event ts unit: usec
+            "dur": dur_ns / 1e3,
+            "pid": pid,
+            "tid": tid,
+            "args": args or {},
+        })
+    return events
+
+
+def export_chrome_trace(path: str, since_ns=None) -> str:
+    """Write the span ring buffer to ``path`` as Chrome trace-event JSON;
+    returns ``path``. Safe to call repeatedly (each call snapshots the ring);
+    an empty ring writes a valid empty trace. ``since_ns`` restricts the
+    export to spans started at/after that ``perf_counter_ns`` stamp."""
+    doc = {"traceEvents": chrome_trace_events(since_ns),
+           "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    logging.info("Wrote %d host span event(s) to %s",
+                 len(doc["traceEvents"]), path)
+    return path
+
+
+_EMIT_LOGGER = None
+
+
+def emit_metrics(global_step: Optional[int] = None, logger=None,
+                 require_file_sink: bool = True) -> int:
+    """Emit the registry snapshot through the benchmark-logger sink; returns
+    the number of rows written.
+
+    With ``require_file_sink`` (the default) emission is a no-op unless
+    ``AUTODIST_BENCHMARK_LOG_DIR`` selects the JSONL file sink — the train
+    loop calls this every log period, and mirroring a whole snapshot into the
+    console logger each period would be noise, not observability. Histograms
+    emit their ``count`` as the value with the full bucket dict in
+    ``extras``."""
+    global _EMIT_LOGGER
+    from autodist_tpu.utils import benchmark_logger
+    if logger is None:
+        if _EMIT_LOGGER is None:
+            candidate = benchmark_logger.get_benchmark_logger()
+            if isinstance(candidate, benchmark_logger.BenchmarkFileLogger):
+                # Cache ONLY the file sink (one open handle per process). A
+                # base-logger result is re-evaluated next call, so setting
+                # AUTODIST_BENCHMARK_LOG_DIR later in the process still
+                # switches emission on instead of being frozen out forever.
+                _EMIT_LOGGER = candidate
+            elif require_file_sink:
+                return 0
+            logger = _EMIT_LOGGER if _EMIT_LOGGER is not None else candidate
+        else:
+            logger = _EMIT_LOGGER
+    return logger.log_metrics(_metrics.snapshot(), global_step=global_step)

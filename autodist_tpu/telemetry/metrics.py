@@ -1,0 +1,201 @@
+"""Process-global metrics registry: Counter / Gauge / Histogram.
+
+The runtime previously had four disconnected accounting islands
+(``WireCounters``, ``ThroughputMeter``, ad-hoc log fields, benchmark logger
+rows); this registry gives them one namespace with a deterministic
+``snapshot()`` that is wire-encodable (plain str/int/float/dict values), so
+the PS ``stats`` opcode can ship a remote worker's or the chief's metrics
+across the transport verbatim.
+
+All instruments are lock-guarded and ``__slots__``-small; creation is
+get-or-create by name so instrumentation sites never race registration.
+Metric names are dotted lowercase (``ps.wire.bytes_sent``,
+``train.readback_wait_s``) — the convention the docs and the stats plane
+assume.
+"""
+
+import bisect
+import threading
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
+           "counter", "gauge", "histogram", "snapshot"]
+
+Number = Union[int, float]
+
+# Default histogram bucket upper bounds for second-valued observations
+# (latency-style: 1ms .. 10s, +inf implicit).
+SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+# For small-integer distributions (staleness lag, queue depths).
+COUNT_BUCKETS = (0, 1, 2, 4, 8, 16, 32)
+
+
+class Counter:
+    """Monotonically increasing sum (ints or floats)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Number = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Number = 1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        return self._value
+
+    def snapshot(self) -> Number:
+        return self._value
+
+
+class Gauge:
+    """Last-set instantaneous value."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Number = 0
+        self._lock = threading.Lock()
+
+    def set(self, value: Number):
+        with self._lock:
+            self._value = value
+
+    def inc(self, n: Number = 1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        return self._value
+
+    def snapshot(self) -> Number:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram: ``observe(v)`` lands in the first bucket whose
+    upper bound satisfies ``v <= bound`` (Prometheus ``le`` semantics), with
+    an implicit ``+inf`` overflow bucket. Bucket edges are fixed at
+    construction — snapshots from different processes with the same edges
+    merge by element-wise addition."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets: Sequence[Number] = SECONDS_BUCKETS):
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(f"histogram buckets must be non-empty and "
+                             f"ascending, got {buckets!r}")
+        self.name = name
+        self.buckets: Tuple[Number, ...] = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: the +inf bucket
+        self._sum: float = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: Number):
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> Dict[str, Number]:
+        """Wire-encodable dict: per-bucket counts keyed ``le:<bound>`` (plus
+        ``le:+inf``), total ``count`` and ``sum``."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        out: Dict[str, Number] = {}
+        for bound, n in zip(self.buckets, counts):
+            out[f"le:{bound:g}"] = n
+        out["le:+inf"] = counts[-1]
+        out["count"] = total
+        out["sum"] = s
+        return out
+
+    def format_compact(self) -> str:
+        """``lag{0:5,1:3,+inf:1}``-style rendering of the NON-EMPTY buckets,
+        for one-line log summaries (the per-worker ``PSServer closed:``
+        breakdown)."""
+        with self._lock:
+            counts = list(self._counts)
+        labels = [f"{b:g}" for b in self.buckets] + ["+inf"]
+        body = ",".join(f"{l}:{n}" for l, n in zip(labels, counts) if n)
+        return "{" + body + "}"
+
+
+class Registry:
+    """Named get-or-create instrument store with a deterministic snapshot."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} is already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[Number]] = None) -> Histogram:
+        return self._get(name, Histogram, buckets or SECONDS_BUCKETS)
+
+    def snapshot(self) -> Dict[str, object]:
+        """``{name: value-or-histogram-dict}``, keys sorted — deterministic
+        for a given set of recorded values regardless of registration order,
+        and wire-encodable as-is (the ``stats`` opcode ships it)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        return {name: m.snapshot() for name, m in items}
+
+    def clear(self):
+        """Drop every instrument (tests; production registries live for the
+        process)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    """The process-global registry every instrumented subsystem shares."""
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets: Optional[Sequence[Number]] = None) -> Histogram:
+    return _REGISTRY.histogram(name, buckets)
+
+
+def snapshot() -> Dict[str, object]:
+    return _REGISTRY.snapshot()

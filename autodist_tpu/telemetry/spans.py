@@ -1,0 +1,185 @@
+"""Host-side span tracing: a thread-aware timeline for the dispatch loop.
+
+``jax.profiler`` answers "what did the DEVICE do"; nothing answered "what did
+the HOST do between dispatches" — data wait, feed sharding, gate round-trips,
+readback sync. This module records named wall-clock spans into a bounded
+in-memory ring buffer, exportable as Chrome trace-event JSON
+(:func:`autodist_tpu.telemetry.export_chrome_trace`) that loads in Perfetto
+next to the device trace (``docs/usage/observability.md`` shows the overlay
+workflow).
+
+Cost contract: when telemetry is DISABLED (the default), :func:`span` performs
+exactly one attribute read and returns a shared no-op context manager — the
+instrumented hot paths (``runner.run``, the train loop, the PS client) pay
+nanoseconds per step, gated in ``bench.py --telemetry-overhead``. When
+enabled, a span costs two ``perf_counter_ns`` reads and one deque append
+(appends on a ``maxlen`` deque are atomic, so recording takes no lock).
+
+Spans nest by containment: Chrome's trace viewer stacks same-thread ``"X"``
+(complete) events whose time ranges nest, so no explicit parent ids are kept.
+"""
+
+import collections
+import functools
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from autodist_tpu import const
+
+__all__ = ["span", "traced", "enable", "disable", "enabled", "clear",
+           "snapshot_spans"]
+
+
+class _State:
+    """Process-global telemetry state. ``enabled`` is THE hot-path gate: the
+    disabled fast path reads this one attribute and nothing else."""
+
+    __slots__ = ("enabled", "ring", "thread_names", "lock", "epoch_ns")
+
+    def __init__(self, capacity: int):
+        self.enabled = False
+        self.ring = collections.deque(maxlen=capacity)
+        self.thread_names: Dict[int, str] = {}
+        self.lock = threading.Lock()
+        # Export offsets span timestamps against this epoch so traces start
+        # near t=0 instead of at an arbitrary monotonic-clock origin.
+        self.epoch_ns = time.perf_counter_ns()
+
+
+def _ring_capacity() -> int:
+    cap = const.ENV.AUTODIST_TELEMETRY_RING.val
+    return max(1, int(cap))
+
+
+_STATE = _State(_ring_capacity())
+
+
+class _NullSpan:
+    """The shared disabled-mode context manager / decorator: every method is
+    a no-op and ``span()`` returns this one instance, so the disabled cost is
+    a single attribute check plus an identity return."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records ``(name, tid, t0_ns, dur_ns, args)`` on exit."""
+
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        st = _STATE
+        tid = threading.get_ident()
+        # Recording takes the state lock: a bare deque.append is atomic, but
+        # readers (snapshot/export, possibly mid-`finally` while a prefetch
+        # thread's span exits) iterate the ring, and CPython raises
+        # "deque mutated during iteration" for a concurrent append. One
+        # uncontended lock per span exit is ~100ns — inside the enabled-mode
+        # budget bench.py --telemetry-overhead tracks.
+        with st.lock:
+            if tid not in st.thread_names:
+                st.thread_names[tid] = threading.current_thread().name
+            st.ring.append((self.name, tid, self._t0, t1 - self._t0,
+                            self.args))
+        return False
+
+
+def span(name: str, **args):
+    """Record the enclosed block as a named host-timeline span.
+
+    ``with telemetry.span("dispatch"): ...`` — extra keyword arguments ride
+    into the Chrome trace event's ``args`` (keep them small and
+    JSON-serializable). Disabled mode returns a shared no-op context manager
+    after a single attribute check."""
+    if not _STATE.enabled:
+        return _NULL_SPAN
+    return _Span(name, args or None)
+
+
+def traced(name: Optional[str] = None, **args):
+    """Decorator face of :func:`span`: ``@telemetry.traced("load_batch")``
+    (or bare ``@telemetry.traced()`` to use the function's qualname). The
+    enabled check happens per CALL, so functions decorated at import time
+    start recording when telemetry is enabled later."""
+    def deco(fn):
+        label = name or fn.__qualname__
+        span_args = args or None
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            if not _STATE.enabled:
+                return fn(*a, **kw)
+            with _Span(label, span_args):
+                return fn(*a, **kw)
+        return wrapper
+    return deco
+
+
+def enable():
+    """Turn span recording (and registry mirroring) on for this process."""
+    _STATE.enabled = True
+
+
+def disable():
+    _STATE.enabled = False
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def clear():
+    """Drop all recorded spans and thread names (the registry is separate —
+    see :func:`autodist_tpu.telemetry.registry`)."""
+    with _STATE.lock:
+        _STATE.ring.clear()
+        _STATE.thread_names.clear()
+        _STATE.epoch_ns = time.perf_counter_ns()
+
+
+def snapshot_spans():
+    """A point-in-time copy of the ring: a list of
+    ``(name, tid, t0_ns, dur_ns, args)`` tuples, oldest first."""
+    with _STATE.lock:
+        return list(_STATE.ring)
+
+
+def _export_state(since_ns: Optional[int] = None):
+    """(pid, epoch_ns, spans, thread_names) for the exporter; ``since_ns``
+    keeps only spans that STARTED at/after that perf_counter_ns stamp (the
+    windowed-export filter ``tracing.trace(with_host_spans=True)`` uses)."""
+    with _STATE.lock:
+        spans = list(_STATE.ring)
+        names = dict(_STATE.thread_names)
+        epoch = _STATE.epoch_ns
+    if since_ns is not None:
+        spans = [s for s in spans if s[2] >= since_ns]
+    return os.getpid(), epoch, spans, names
+
+
+# AUTODIST_TELEMETRY=1 enables at import so every entry point (examples,
+# bench, worker processes the coordinator launches with an inherited env)
+# records without code changes.
+if const.ENV.AUTODIST_TELEMETRY.val:
+    enable()

@@ -12,7 +12,7 @@ from typing import Any, Callable, Iterable, Optional, Union
 
 import jax
 
-from autodist_tpu import const
+from autodist_tpu import const, telemetry
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.runner import TrainState
 from autodist_tpu.utils import logging
@@ -135,7 +135,8 @@ def train(runner, params: PyTree,
         # Final save stays synchronous: train() returning means the state is
         # durably on disk (save() joins any in-flight periodic write first).
         if saver is not None and save_participant and int(final_state.step) > start:
-            saver.save(final_state, prefix_base, runner=runner)
+            with telemetry.span("train.checkpoint", final=True):
+                saver.save(final_state, prefix_base, runner=runner)
         if saver is not None:
             saver.wait()
         return final_state
@@ -151,14 +152,17 @@ def train(runner, params: PyTree,
     loss = None
     for step_i in range(start, steps):
         if next_batch is not None:
-            batch = next_batch(step_i)
+            with telemetry.span("train.data_wait"):
+                batch = next_batch(step_i)
         else:
             try:
-                batch = next(batch_iter)
+                with telemetry.span("train.data_wait"):
+                    batch = next(batch_iter)
             except StopIteration:
                 logging.info("train: batch iterator exhausted at step %d", step_i)
                 break
-        state, fetched = runner.run(state, batch)
+        with telemetry.span("train.dispatch"):
+            state, fetched = runner.run(state, batch)
         loss = fetched[0] if isinstance(fetched, tuple) else fetched
         if meter is None and log_every:
             meter = _make_meter(batch, batch_size, log_every)
@@ -171,12 +175,20 @@ def train(runner, params: PyTree,
             if rate is not None:
                 # Async-PS runs append their transport accounting (zero-copy
                 # wire counters) so per-period logs show parameter/gradient
-                # traffic next to throughput.
+                # traffic next to throughput. `q` is the dispatch-ahead queue
+                # depth (always 0 in the per-step loop), `rb` the seconds this
+                # period spent blocked on device->host readback — together
+                # they say whether a slow period was compute, readback, or
+                # host-side stall, from the log line alone.
                 stats = getattr(runner, "wire_stats", None)
                 stats = stats() if callable(stats) else None
-                logging.info("train: step %d loss %.4f %.1f examples/s%s",
+                logging.info("train: step %d loss %.4f %.1f examples/s "
+                             "| q 0 rb %.3fs%s",
                              step_i + 1, float(loss), rate,
+                             meter.last_readback_s,
                              f" | {stats.format_line()}" if stats else "")
+                if telemetry.enabled():
+                    telemetry.emit_metrics(global_step=step_i + 1)
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
         if (eval_every and (step_i + 1) % eval_every == 0
@@ -185,7 +197,8 @@ def train(runner, params: PyTree,
             # template and AsyncPSRunner.evaluate raises there by design. Sync
             # SPMD processes all evaluate together (the compiled eval is a
             # collective program).
-            val = runner.evaluate(state, eval_batch, eval_fn)
+            with telemetry.span("train.eval"):
+                val = runner.evaluate(state, eval_batch, eval_fn)
             try:
                 logging.info("train: step %d eval %.6f", step_i + 1, float(val))
             except (TypeError, ValueError):
@@ -194,9 +207,12 @@ def train(runner, params: PyTree,
                 on_eval(step_i + 1, val)
         if (saver is not None and save_participant and save_every
                 and (step_i + 1) % save_every == 0 and step_i + 1 < steps):
-            saver.save(state, prefix_base, runner=runner,
-                       async_write=async_save)
+            with telemetry.span("train.checkpoint"):
+                saver.save(state, prefix_base, runner=runner,
+                           async_write=async_save)
 
+    if meter is not None:
+        meter.finish()   # freeze the run clock: average stays the TRAIN rate
     return _finish(state)
 
 
@@ -236,17 +252,18 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
         if exhausted or i >= steps:
             return None
         blk = []
-        for j in range(min(unroll, next_boundary(i) - i)):
-            if next_batch is not None:
-                blk.append(next_batch(i + j))
-            else:
-                try:
-                    blk.append(next(batch_iter))
-                except StopIteration:
-                    exhausted = True
-                    logging.info("train: batch iterator exhausted at step %d",
-                                 i + len(blk))
-                    break
+        with telemetry.span("train.data_wait"):
+            for j in range(min(unroll, next_boundary(i) - i)):
+                if next_batch is not None:
+                    blk.append(next_batch(i + j))
+                else:
+                    try:
+                        blk.append(next(batch_iter))
+                    except StopIteration:
+                        exhausted = True
+                        logging.info("train: batch iterator exhausted at "
+                                     "step %d", i + len(blk))
+                        break
         if not blk:
             return None
         if first_batch is None:
@@ -257,25 +274,37 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
     step_i = start
     block = gather(step_i)
     while block is not None:
-        state, fetched = runner.run_many(state, block)
+        with telemetry.span("train.dispatch", steps=block.length):
+            state, fetched = runner.run_many(state, block)
         losses = fetched[0] if isinstance(fetched, tuple) else fetched
         step_i += block.length
         # Dispatch-ahead: run_many returns as soon as the K-step program is
         # enqueued; gather + pre-shard the next block NOW, before any sync
         # below, so host batch assembly and h->d transfer overlap the device.
         next_block = gather(step_i)
+        queue_depth = 1 if next_block is not None else 0
+        if telemetry.enabled():
+            telemetry.gauge("train.dispatch_queue_depth").set(queue_depth)
         if meter is None and log_every:
             meter = _make_meter(first_batch, batch_size, log_every)
         if meter is not None:
             rate = meter.step_many(block.length, sync=losses)
             if rate is not None:
                 last = float(jax.device_get(losses)[-1])
-                logging.info("train: step %d loss %.4f %.1f examples/s",
-                             step_i, last, rate)
+                # `q`: dispatch-ahead queue depth (0 means the host failed to
+                # stay ahead of the device — data-starved); `rb`: period
+                # seconds blocked on loss readback.
+                logging.info("train: step %d loss %.4f %.1f examples/s "
+                             "| q %d rb %.3fs",
+                             step_i, last, rate, queue_depth,
+                             meter.last_readback_s)
+                if telemetry.enabled():
+                    telemetry.emit_metrics(global_step=step_i)
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)
         if eval_every and step_i % eval_every == 0:
-            val = runner.evaluate(state, eval_batch, eval_fn)
+            with telemetry.span("train.eval"):
+                val = runner.evaluate(state, eval_batch, eval_fn)
             try:
                 logging.info("train: step %d eval %.6f", step_i, float(val))
             except (TypeError, ValueError):
@@ -284,7 +313,10 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                 on_eval(step_i, val)
         if (saver is not None and save_participant and save_every
                 and step_i % save_every == 0 and step_i < steps):
-            saver.save(state, prefix_base, runner=runner,
-                       async_write=async_save)
+            with telemetry.span("train.checkpoint"):
+                saver.save(state, prefix_base, runner=runner,
+                           async_write=async_save)
         block = next_block
+    if meter is not None:
+        meter.finish()   # freeze the run clock: average stays the TRAIN rate
     return state

@@ -49,6 +49,23 @@ class BaseBenchmarkLogger:
     def log_run_info(self, run_info: Dict[str, Any]):
         logging.info("Benchmark run: %s", run_info)
 
+    def log_metrics(self, snapshot: Dict[str, Any],
+                    global_step: Optional[int] = None) -> int:
+        """Emit a telemetry-registry snapshot (``telemetry.snapshot()``) as
+        one metric row per instrument; returns the row count. Histogram
+        snapshots (dicts) log their ``count`` as the value with the bucket
+        dict riding in ``extras`` — every sink (console, file) inherits this,
+        so registry metrics land wherever ordinary metrics do."""
+        rows = 0
+        for name, value in snapshot.items():
+            if isinstance(value, dict):
+                self.log_metric(name, value.get("count", 0), unit="count",
+                                global_step=global_step, extras=value)
+            else:
+                self.log_metric(name, value, global_step=global_step)
+            rows += 1
+        return rows
+
     def on_finish(self, status: str = "success"):
         pass
 

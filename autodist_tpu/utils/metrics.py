@@ -10,9 +10,31 @@ implementation.
 
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Union
 
+from autodist_tpu import telemetry
 from autodist_tpu.utils import logging
+
+_WIRE_TEL = None
+
+
+def _wire_registry():
+    """The telemetry registry's process-aggregate wire counters, or ``None``
+    while telemetry is disabled (the common case — one ``enabled`` check per
+    increment). Cached after first use; the registry is get-or-create so the
+    cache can never race."""
+    if not telemetry.enabled():
+        return None
+    global _WIRE_TEL
+    if _WIRE_TEL is None:
+        reg = telemetry.registry()
+        _WIRE_TEL = (reg.counter("ps.wire.bytes_sent"),
+                     reg.counter("ps.wire.msgs_sent"),
+                     reg.counter("ps.wire.encode_s"),
+                     reg.counter("ps.wire.bytes_received"),
+                     reg.counter("ps.wire.msgs_received"),
+                     reg.counter("ps.wire.decode_s"))
+    return _WIRE_TEL
 
 
 class WireCounters:
@@ -23,12 +45,19 @@ class WireCounters:
     instance per socket (client side) or aggregated across connections
     (server side — increments are locked so concurrent handler threads
     cannot lose counts). ``format_line()`` is the compact rendering the
-    async-PS log line carries."""
+    async-PS log line carries.
+
+    With telemetry enabled, primary instances (``mirror=True``, the default)
+    additionally fold every increment into the process-global registry's
+    ``ps.wire.*`` counters; secondary views over the same traffic (the PS
+    server's per-worker breakdown) pass ``mirror=False`` so bytes are never
+    registry-counted twice. :meth:`merge` never mirrors for the same reason —
+    the folded counters already mirrored when they streamed."""
 
     __slots__ = ("bytes_sent", "bytes_received", "msgs_sent", "msgs_received",
-                 "encode_s", "decode_s", "_lock")
+                 "encode_s", "decode_s", "_lock", "_mirror")
 
-    def __init__(self):
+    def __init__(self, mirror: bool = True):
         self.bytes_sent = 0
         self.bytes_received = 0
         self.msgs_sent = 0
@@ -36,18 +65,40 @@ class WireCounters:
         self.encode_s = 0.0
         self.decode_s = 0.0
         self._lock = threading.Lock()
+        self._mirror = mirror
 
     def add_sent(self, nbytes: int, encode_s: float = 0.0):
         with self._lock:
             self.bytes_sent += nbytes
             self.msgs_sent += 1
             self.encode_s += encode_s
+        tel = _wire_registry() if self._mirror else None
+        if tel is not None:
+            tel[0].inc(nbytes)
+            tel[1].inc()
+            tel[2].inc(encode_s)
 
     def add_received(self, nbytes: int, decode_s: float = 0.0):
         with self._lock:
             self.bytes_received += nbytes
             self.msgs_received += 1
             self.decode_s += decode_s
+        tel = _wire_registry() if self._mirror else None
+        if tel is not None:
+            tel[3].inc(nbytes)
+            tel[4].inc()
+            tel[5].inc(decode_s)
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """Wire-encodable dict of all six counters under one lock hold (the
+        ``stats`` opcode's per-connection payload)."""
+        with self._lock:
+            return {"bytes_sent": self.bytes_sent,
+                    "bytes_received": self.bytes_received,
+                    "msgs_sent": self.msgs_sent,
+                    "msgs_received": self.msgs_received,
+                    "encode_s": self.encode_s,
+                    "decode_s": self.decode_s}
 
     def merge(self, other: "WireCounters"):
         """Fold another counter set into this one (prefetch-join accounting:
@@ -72,17 +123,34 @@ class WireCounters:
                 f"enc {enc:.2f}ms/msg dec {dec:.2f}ms/msg")
 
 
-def _sync(value) -> None:
+def _sync(value) -> float:
     """Force a device->host read of ``value`` (a completion fence for the
     asynchronously dispatched step it came from); a no-op when jax is absent
-    or the value is host-side already."""
+    or the value is host-side already. Returns the seconds spent blocked on
+    the readback (0.0 when skipped) and records them as the
+    ``train.readback_wait_s`` counter / ``train.readback_wait`` span when
+    telemetry is on."""
     if value is None:
-        return
+        return 0.0
     try:
         import jax
-        jax.device_get(value)
-    except Exception:
-        pass
+    except ImportError:  # meter used from a jax-less tool: rates become
+        return 0.0       # dispatch rates, which is all that exists there
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span("train.readback_wait"):
+            jax.device_get(value)
+    except (RuntimeError, ValueError, TypeError) as e:
+        # Narrow on purpose: a failed readback must not crash metering, but
+        # the old bare `except Exception: pass` silently turned the meter
+        # into a dispatch-rate meter — leave a diagnosable trace instead.
+        logging.debug("metrics._sync: device readback failed (%s: %s); the "
+                      "period rate will measure dispatch, not compute",
+                      type(e).__name__, e)
+    elapsed = time.perf_counter() - t0
+    if telemetry.enabled():
+        telemetry.counter("train.readback_wait_s").inc(elapsed)
+    return elapsed
 
 
 class ThroughputMeter:
@@ -102,8 +170,13 @@ class ThroughputMeter:
         # when the last warmup step lands.
         self._period_start: float = now
         self._run_start: float = now
+        self._run_end: Optional[float] = None   # frozen by finish()
         self._run_steps = 0
         self._period_steps = 0   # block-mode (step_many) period accounting
+        self._period_readback_s = 0.0
+        # Seconds the LAST CLOSED period spent blocked on device->host
+        # readback — the `rb` field on the train: log line.
+        self.last_readback_s = 0.0
         self.history: List[float] = []
 
     def step(self, sync=None) -> Optional[float]:
@@ -114,10 +187,11 @@ class ThroughputMeter:
         of it before taking the clock — otherwise rates measure dispatch, not
         compute."""
         self._step += 1
+        self._run_end = None   # stepping again unfreezes a finish()ed clock
         at_boundary = (self._step > self._warmup
                        and (self._run_steps + 1) % self._log_every == 0)
         if at_boundary or self._step == self._warmup:
-            _sync(sync)
+            self._period_readback_s += _sync(sync)
         now = time.perf_counter()
         if self._step <= self._warmup:
             # Exclude compile/warmup from rates (reference TimeHistory did the same
@@ -133,6 +207,8 @@ class ThroughputMeter:
             if self._log:
                 logging.info("step %d: %.1f %s/sec", self._step, rate, self._unit)
             self._period_start = now
+            self.last_readback_s = self._period_readback_s
+            self._period_readback_s = 0.0
             return rate
         return None
 
@@ -152,6 +228,7 @@ class ThroughputMeter:
             return None
         first = self._step == 0
         self._step += n
+        self._run_end = None   # stepping again unfreezes a finish()ed clock
         if first and self._warmup:
             _sync(sync)
             now = time.perf_counter()
@@ -164,7 +241,7 @@ class ThroughputMeter:
         self._period_steps += n
         if self._period_steps < self._log_every:
             return None
-        _sync(sync)
+        self._period_readback_s += _sync(sync)
         now = time.perf_counter()
         rate = self._period_steps * self._batch_size / (now - self._period_start)
         self.history.append(rate)
@@ -172,12 +249,27 @@ class ThroughputMeter:
             logging.info("step %d: %.1f %s/sec", self._step, rate, self._unit)
         self._period_start = now
         self._period_steps = 0
+        self.last_readback_s = self._period_readback_s
+        self._period_readback_s = 0.0
         return rate
+
+    def finish(self) -> Optional[float]:
+        """Freeze the run clock at training end; returns the final average.
+
+        :attr:`average` reads the clock at CALL time, so querying it after
+        the run — post-eval, teardown, a summary printed minutes later —
+        silently diluted the rate with non-training wall time. ``train()``
+        calls this when its loop exits; idempotent, and a subsequent
+        ``step()`` unfreezes (the meter is training again)."""
+        if self._run_end is None:
+            self._run_end = time.perf_counter()
+        return self.average
 
     @property
     def average(self) -> Optional[float]:
-        """Run-average rate excluding warmup (reference logged the same)."""
+        """Run-average rate excluding warmup (reference logged the same).
+        Uses the clock frozen by :meth:`finish` when the run has ended."""
         if not self._run_steps:
             return None
-        elapsed = time.perf_counter() - self._run_start
-        return self._run_steps * self._batch_size / elapsed
+        end = self._run_end if self._run_end is not None else time.perf_counter()
+        return self._run_steps * self._batch_size / (end - self._run_start)

@@ -8,9 +8,20 @@ Parity with reference §5.1:
 - Graph-evolution snapshots (``utils/visualization_util.py:24-36`` wrote the graph
   at each transform stage) map to :func:`dump_stage`: the jaxpr and StableHLO text
   of the train step at each compilation stage, written under ``graphs/<tag>/``.
+
+``trace(..., with_host_spans=True)`` additionally records the host-side
+telemetry spans (:mod:`autodist_tpu.telemetry`) for the traced window and
+writes them as ``host_spans.json`` inside the same trace directory — open the
+profiler's ``*.trace.json.gz`` and ``host_spans.json`` together in
+ui.perfetto.dev (Perfetto merges multiple opened files into one timeline) to
+see host dispatch/wait spans next to device execution. The two traces use
+different clock origins, so align on a recognizable boundary (e.g. the first
+``runner.run.dispatch`` span vs the first device program) rather than
+absolute timestamps; see docs/usage/observability.md.
 """
 
 import contextlib
+import itertools
 import os
 import time
 from typing import Optional
@@ -18,20 +29,51 @@ from typing import Optional
 from autodist_tpu import const
 from autodist_tpu.utils import logging
 
+# Monotonic per-process suffix for default trace dirs: a wall-clock-second
+# name alone collides when two traces start within the same second (the
+# second trace silently wrote into — and interleaved with — the first's dir).
+_TRACE_SEQ = itertools.count()
+
+
+def _unique_trace_dir(name: str) -> str:
+    """Collision-free default trace directory under the working dir."""
+    return os.path.join(const.DEFAULT_TRACE_DIR,
+                        f"{name}_{int(time.time())}_{next(_TRACE_SEQ):03d}")
+
 
 @contextlib.contextmanager
-def trace(name: str = "trace", trace_dir: Optional[str] = None):
+def trace(name: str = "trace", trace_dir: Optional[str] = None,
+          with_host_spans: bool = False):
     """Profile the enclosed steps: ``with tracing.trace(): runner.run(...)``.
 
     Produces a Perfetto-compatible trace viewable in TensorBoard or ui.perfetto.dev
-    (the chrome-trace timeline counterpart)."""
+    (the chrome-trace timeline counterpart). With ``with_host_spans=True``,
+    telemetry span recording is enabled for the window and the host timeline
+    is written to ``<trace_dir>/host_spans.json`` on exit (telemetry returns
+    to its prior enabled/disabled state afterwards) — load both files in
+    Perfetto for a host+device overlay (see module docstring)."""
     import jax
-    trace_dir = trace_dir or os.path.join(const.DEFAULT_TRACE_DIR,
-                                          f"{name}_{int(time.time())}")
+    trace_dir = trace_dir or _unique_trace_dir(name)
     os.makedirs(trace_dir, exist_ok=True)
     logging.info("Writing profiler trace to %s", trace_dir)
-    with jax.profiler.trace(trace_dir):
-        yield trace_dir
+    if with_host_spans:
+        from autodist_tpu import telemetry
+        was_enabled = telemetry.enabled()
+        # Window stamp BEFORE enabling: host_spans.json carries only spans
+        # started inside this trace window, not whatever an earlier window
+        # (or an always-enabled process) left in the ring.
+        window_start_ns = time.perf_counter_ns()
+        telemetry.enable()
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield trace_dir
+    finally:
+        if with_host_spans:
+            if not was_enabled:
+                telemetry.disable()
+            telemetry.export_chrome_trace(
+                os.path.join(trace_dir, "host_spans.json"),
+                since_ns=window_start_ns)
 
 
 def dump_stage(tag: str, stage: str, fn, *example_args,
@@ -56,5 +98,6 @@ def dump_stage(tag: str, stage: str, fn, *example_args,
         logging.debug("Dumped %s stage %s", tag, stage)
         return base
     except Exception as e:  # diagnostics must never break training
-        logging.warning("Stage dump %s/%s failed: %s", tag, stage, e)
+        logging.warning("Stage dump %s/%s (dump path %s.*) failed: %s",
+                        tag, stage, base, e)
         return None

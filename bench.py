@@ -151,6 +151,123 @@ def wire_bench(payload_mib: int = 40, rounds: int = 4):
     return result
 
 
+def telemetry_overhead(steps: int = 150):
+    """Telemetry cost micro-bench (CPU micro-model, host-dispatch-bound — the
+    same shape class as the unroll sweep's CPU leg, so step time is dominated
+    by exactly the host path the spans instrument):
+
+    - steps/s through ``runner.run`` with telemetry DISABLED (production
+      default) and ENABLED (span ring + registry recording),
+    - the disabled span construct's direct cost in ns (1e5 no-op
+      ``with telemetry.span(...)`` blocks), and the implied
+      ``disabled_overhead_pct`` — span cost x spans-per-step as a fraction of
+      the measured step time. This is the gated number: the recorded
+      ``telemetry_overhead`` row in PERF_BASELINE.json carries
+      ``max_disabled_overhead_pct`` (2.0), and exceeding it means the
+      disabled fast path stopped being a single attribute check.
+
+    The steps/s pair is cross-checked against the recorded rows only on a
+    matching platform (absolute CPU rates are machine-specific); the span-ns
+    gate is machine-relative by construction so it gates everywhere."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(loss_fn, params, optax.adam(1e-3),
+                                           example_batch=batch)
+    state = runner.init(params)
+
+    def measure(n):
+        nonlocal state
+        loss = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = runner.run(state, batch)
+        _ = jax.device_get(loss)   # completion fence
+        return n / (time.perf_counter() - t0)
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    measure(10)                    # compile + warmup
+    rate_disabled = measure(steps)
+    telemetry.enable()
+    measure(3)
+    rate_enabled = measure(steps)
+    telemetry.clear()
+
+    # Direct disabled-construct cost, independent of host-load noise in the
+    # steps/s pair: N no-op spans, ns each.
+    telemetry.disable()
+    n_spans = 100_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n_spans):
+        with telemetry.span("bench"):
+            pass
+    span_ns = (time.perf_counter_ns() - t0) / n_spans
+    if was_enabled:
+        telemetry.enable()
+
+    # Spans per step on the instrumented per-step train path: train.data_wait,
+    # train.dispatch, runner.shard_batch, runner.run.dispatch, plus headroom
+    # for the meter's boundary readback and async-PS client spans.
+    spans_per_step = 8
+    step_ns = 1e9 / rate_disabled
+    disabled_overhead_pct = 100.0 * span_ns * spans_per_step / step_ns
+
+    result = {
+        "metric": f"telemetry_overhead ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size})",
+        "unit": "steps/s",
+        "rows": {"disabled": round(rate_disabled, 2),
+                 "enabled": round(rate_enabled, 2)},
+        "enabled_vs_disabled": round(rate_enabled / rate_disabled, 4),
+        "disabled_span_ns": round(span_ns, 1),
+        "spans_per_step": spans_per_step,
+        "disabled_overhead_pct": round(disabled_overhead_pct, 4),
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("telemetry_overhead")
+        if recorded:
+            max_pct = recorded.get("max_disabled_overhead_pct", 2.0)
+            if disabled_overhead_pct > max_pct:
+                print(f"WARNING: disabled-mode telemetry overhead "
+                      f"{disabled_overhead_pct:.3f}% of step time exceeds the "
+                      f"{max_pct}% gate — the disabled span fast path "
+                      f"regressed (see PERF_BASELINE.json "
+                      f"telemetry_overhead)", file=sys.stderr)
+            floor = recorded.get("enabled_vs_disabled_floor")
+            if (floor and recorded.get("platform") == platform
+                    and result["enabled_vs_disabled"] < floor):
+                print(f"WARNING: enabled-telemetry steps/s is "
+                      f"{result['enabled_vs_disabled']:.2f}x the disabled "
+                      f"rate, below the recorded {floor:.2f}x floor — "
+                      f"enabled-mode recording got costlier (see "
+                      f"PERF_BASELINE.json telemetry_overhead)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    return result
+
+
 def unroll_sweep(factors):
     """Measure the fused multi-step path (``runner.run_many``) at each unroll
     factor and print ONE JSON line with the steps/s curve.
@@ -265,6 +382,13 @@ def main(argv=None):
              "the speedup against the recorded ps_wire row in "
              "PERF_BASELINE.json; CPU-only host work, runs anywhere")
     parser.add_argument(
+        "--telemetry-overhead", action="store_true",
+        help="measure the host-telemetry cost on the CPU micro-model: "
+             "steps/s with telemetry disabled vs enabled plus the disabled "
+             "no-op span cost in ns, gated against the telemetry_overhead "
+             "row in PERF_BASELINE.json (disabled mode must stay within "
+             "max_disabled_overhead_pct of step time)")
+    parser.add_argument(
         "--profile", type=int, default=0, metavar="N",
         help="dump a jax.profiler trace (Perfetto/TensorBoard format) of an "
              "N-step window after warmup; the trace directory is reported in "
@@ -272,6 +396,9 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if args.wire:
         wire_bench()
+        return
+    if args.telemetry_overhead:
+        telemetry_overhead()
         return
     if args.unroll:
         try:

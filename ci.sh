@@ -92,6 +92,9 @@ echo "=== [4/4] bench smoke ==="
 # Wire micro-bench first: CPU-safe, sub-minute, and it gates the zero-copy
 # PS codec path against the recorded ps_wire row on every CI pass.
 python bench.py --wire
+# Telemetry cost gate: disabled-mode span overhead must stay within
+# max_disabled_overhead_pct (PERF_BASELINE.json telemetry_overhead row).
+python bench.py --telemetry-overhead
 python bench.py
 
 echo "=== CI OK ==="
