@@ -64,6 +64,11 @@ KNOWN_FLAGS = {
     "AUTODIST_BENCHMARK_LOG_DIR": "benchmark metric file sink directory",
     "AUTODIST_TELEMETRY": "enable host span tracing + metrics registry",
     "AUTODIST_TELEMETRY_RING": "span ring-buffer capacity (spans retained)",
+    "AUTODIST_TRACE_PULL": "PS worker pushes its span ring to the chief at "
+                           "close (cluster trace plane)",
+    "AUTODIST_WATCHDOG": "PS straggler/stall watchdog thread (0 disables)",
+    "AUTODIST_WATCHDOG_SEC": "watchdog sample interval seconds (a worker "
+                             "silent for 3x this is flagged stalled)",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -127,6 +132,16 @@ _ENV_DEFAULTS = {
     # mirroring on/off, and the span ring buffer's capacity.
     "AUTODIST_TELEMETRY": False,
     "AUTODIST_TELEMETRY_RING": 65536,
+    # Cluster trace plane: a remote PS worker deposits its span ring on the
+    # chief when it closes (telemetry must also be enabled for there to be
+    # spans to push).
+    "AUTODIST_TRACE_PULL": False,
+    # PS-server straggler/stall watchdog: samples per-worker last-seen ages
+    # and staleness lags, flags anomalies into the metrics registry, warns
+    # (rate-limited) naming the slow worker. On by default — one bounded-wait
+    # thread per server, a handful of dict reads per interval.
+    "AUTODIST_WATCHDOG": True,
+    "AUTODIST_WATCHDOG_SEC": 10.0,
 }
 
 class ENV(enum.Enum):
@@ -153,6 +168,9 @@ class ENV(enum.Enum):
     AUTODIST_BENCHMARK_LOG_DIR = "AUTODIST_BENCHMARK_LOG_DIR"
     AUTODIST_TELEMETRY = "AUTODIST_TELEMETRY"
     AUTODIST_TELEMETRY_RING = "AUTODIST_TELEMETRY_RING"
+    AUTODIST_TRACE_PULL = "AUTODIST_TRACE_PULL"
+    AUTODIST_WATCHDOG = "AUTODIST_WATCHDOG"
+    AUTODIST_WATCHDOG_SEC = "AUTODIST_WATCHDOG_SEC"
 
     @property
     def val(self):
@@ -165,6 +183,8 @@ class ENV(enum.Enum):
             return raw.strip().lower() not in ("", "0", "false", "no", "off")
         if isinstance(default, int):
             return int(raw)
+        if isinstance(default, float):
+            return float(raw)
         return raw
 
 

@@ -43,6 +43,7 @@ instead of being misparsed as an absurd length. Sockets carrying a timeout
 always use the Python path to keep timeout semantics.
 """
 
+import math
 import os
 import socket
 import socketserver
@@ -336,12 +337,131 @@ class _WorkerStats:
     """Server-side per-worker accounting: the wire traffic of every
     connection bound to one worker id (``mirror=False`` — the server's
     aggregate ``PSServer.wire`` already mirrors these bytes into the
-    telemetry registry, and one byte must not be registry-counted twice)."""
+    telemetry registry, and one byte must not be registry-counted twice),
+    plus the monotonic stamp of the worker's last completed exchange
+    (the watchdog's stall signal and the ``last_seen_s`` field in
+    ``stats_snapshot``)."""
 
-    __slots__ = ("wire",)
+    __slots__ = ("wire", "last_seen")
 
     def __init__(self):
         self.wire = WireCounters(mirror=False)
+        self.last_seen = time.monotonic()
+
+
+class _StragglerWatchdog:
+    """Background straggler/stall monitor for a :class:`PSServer`.
+
+    Every ``interval`` seconds (a BOUNDED ``Event.wait`` — GL005's rule) it
+    samples, per registered worker, (a) the age of the last completed
+    exchange and (b) the instantaneous staleness lag from the gate
+    (:meth:`StalenessController.live_lags`), then:
+
+    - sets ``ps.worker.last_seen_s.w<id>`` registry gauges,
+    - flags a worker STALLED when it has been silent longer than
+      ``stall_after`` (default 3x the interval),
+    - flags a worker a STRAGGLER when some peer is parked AT the staleness
+      bound while this worker sits at lag 0 — it is the one everyone is
+      waiting for (a merely-stalled worker is often the gate's *victim*;
+      the straggler flag names the culprit),
+    - bumps the ``ps.straggler.flags`` counter, records a structured
+      ``ps.anomaly.{stall,straggler}`` event in the registry, and emits a
+      rate-limited ``train:`` warning naming the worker.
+
+    ``flagged`` is the most recent tick's flagged-worker set (tests and
+    dashboards read it); anomalies persist in ``telemetry.events()``.
+    """
+
+    # A worker silent for this many intervals is considered stalled.
+    STALL_INTERVALS = 3.0
+    # Per-worker floor between repeated warnings about the same condition.
+    WARN_EVERY_S = 60.0
+
+    def __init__(self, server: "PSServer", interval: float,
+                 warn_every: Optional[float] = None):
+        self._server = server
+        self._interval = max(0.01, float(interval))
+        self._stall_after = self.STALL_INTERVALS * self._interval
+        self._warn_every = self.WARN_EVERY_S if warn_every is None \
+            else float(warn_every)
+        self._last_warn: dict = {}
+        # Consecutive ticks each worker has satisfied the straggler
+        # condition: a fast worker parked AT the bound for a moment is
+        # NORMAL steady-state gating, so the flag needs persistence (the
+        # same STALL_INTERVALS the silence check uses) before it fires.
+        self._straggler_ticks: dict = {}
+        self._stop = threading.Event()
+        self.flagged: set = set()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ps-watchdog")
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=self._interval + 5.0)
+
+    def _run(self):
+        while not self._stop.wait(self._interval):  # bounded: GL005-clean
+            try:
+                self._sample()
+            except Exception as e:  # monitoring must never take down serving
+                logging.debug("PS watchdog sample failed: %s", e)
+
+    def _sample(self):
+        now = time.monotonic()
+        server = self._server
+        with server._worker_stats_lock:
+            ages = {wid: now - ws.last_seen
+                    for wid, ws in server._worker_stats.items()}
+        controller = getattr(server._runner, "controller", None)
+        lags = controller.live_lags() if controller is not None else {}
+        bound = controller.bound if controller is not None else math.inf
+        if controller is not None:
+            # A worker absent from live_lags was retired (clean close or
+            # disconnect): its frozen last-seen age would otherwise flag it
+            # stalled forever, drowning real anomalies.
+            ages = {wid: age for wid, age in ages.items() if wid in lags}
+        reg = telemetry.registry()
+        for wid, age in ages.items():
+            reg.gauge(f"ps.worker.last_seen_s.w{wid}").set(round(age, 3))
+        flagged = {}
+        for wid, age in ages.items():
+            if age > self._stall_after:
+                flagged[wid] = ("stall", age)
+        straggling = set()
+        if math.isfinite(bound) and len(lags) >= 2 \
+                and max(lags.values()) >= bound:
+            # Someone is parked at the bound: the lag-0 worker(s) hold the
+            # min step count everyone else is gated on.
+            straggling = {wid for wid, lag in lags.items() if lag == 0}
+        # Persistence gate: flag only after STALL_INTERVALS consecutive
+        # ticks — a healthy bounded-staleness run has workers momentarily
+        # at the bound every step, and a single sampled instant is noise.
+        self._straggler_ticks = {wid: self._straggler_ticks.get(wid, 0) + 1
+                                 for wid in straggling}
+        for wid in sorted(straggling, key=str):
+            if self._straggler_ticks[wid] >= self.STALL_INTERVALS \
+                    and wid not in flagged:
+                flagged[wid] = ("straggler", ages.get(wid, 0.0))
+        for wid, (kind, age) in sorted(flagged.items(), key=lambda kv:
+                                       str(kv[0])):
+            reg.counter("ps.straggler.flags").inc()
+            reg.event(f"ps.anomaly.{kind}", worker=wid,
+                      last_seen_s=round(age, 3))
+            if now - self._last_warn.get(wid, -math.inf) >= self._warn_every:
+                self._last_warn[wid] = now
+                if kind == "stall":
+                    logging.warning(
+                        "train: PS watchdog: worker %s looks STALLED — no "
+                        "completed exchange for %.1fs (threshold %.1fs)",
+                        wid, age, self._stall_after)
+                else:
+                    logging.warning(
+                        "train: PS watchdog: worker %s is the STRAGGLER — "
+                        "peers are parked at the staleness bound (%s) "
+                        "waiting for it (last seen %.1fs ago)",
+                        wid, int(bound), age)
+        self.flagged = set(flagged)
 
 
 class PSServer:
@@ -354,13 +474,24 @@ class PSServer:
     trust domain is still the caller's explicit choice."""
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
-                 listen_sock: Optional[socket.socket] = None):
+                 listen_sock: Optional[socket.socket] = None,
+                 watchdog: Optional[bool] = None,
+                 watchdog_interval: Optional[float] = None):
         """``listen_sock``: an already-bound listening socket to adopt — the
         launcher binds it BEFORE shipping the address to workers, so the port is
-        reserved rather than guessed (no bind race at init time)."""
+        reserved rather than guessed (no bind race at init time).
+
+        ``watchdog``/``watchdog_interval`` override the
+        ``AUTODIST_WATCHDOG``/``AUTODIST_WATCHDOG_SEC`` defaults for the
+        straggler/stall monitor (:class:`_StragglerWatchdog`)."""
         if runner.service is None:
             raise RuntimeError("Call runner.init(params) before serving")
         self._runner = runner
+        self._t_started = time.monotonic()
+        # Span rings workers deposited over the `push_trace` opcode, keyed by
+        # worker id — the chief-side half of telemetry.collect_cluster_trace.
+        self._worker_traces: dict = {}
+        self._trace_lock = threading.Lock()
         # Aggregate wire accounting across every connection this server has
         # handled (payload bytes, message counts, encode/decode time) —
         # surfaced in the async-PS log line and summarized at close().
@@ -442,10 +573,13 @@ class PSServer:
                         if self.worker_id is not None:
                             # Once the connection is bound to a worker, its
                             # traffic also lands in that worker's breakdown
-                            # (the codec-time split stays aggregate-only).
+                            # (the codec-time split stays aggregate-only),
+                            # and the exchange refreshes the worker's
+                            # last-seen stamp (the watchdog's stall signal).
                             ws = outer._stats_for(self.worker_id)
                             ws.wire.add_received(nrecv)
                             ws.wire.add_sent(nsent)
+                            ws.last_seen = time.monotonic()
                         # Drop this message's decoded tree (it aliases the
                         # recv buffer) BEFORE the next recv, or the loop
                         # variable itself would pin the buffer and defeat
@@ -486,6 +620,13 @@ class PSServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        from autodist_tpu import const
+        if watchdog is None:
+            watchdog = const.ENV.AUTODIST_WATCHDOG.val
+        if watchdog_interval is None:
+            watchdog_interval = const.ENV.AUTODIST_WATCHDOG_SEC.val
+        self._watchdog = _StragglerWatchdog(self, watchdog_interval) \
+            if watchdog else None
         logging.info("PSServer listening on %s:%d", *self._server.server_address)
 
     @property
@@ -502,19 +643,49 @@ class PSServer:
     def stats_snapshot(self) -> dict:
         """The server's observability snapshot, wire-encodable (the ``stats``
         opcode's reply): the process-global telemetry registry, the server's
-        aggregate wire counters, and a per-worker breakdown of wire traffic
-        plus staleness-lag histograms from the gate."""
+        aggregate wire counters, its uptime, structured anomaly events (the
+        watchdog's straggler/stall records), and a per-worker breakdown of
+        wire traffic, last-seen age, and staleness-lag histograms from the
+        gate."""
+        now = time.monotonic()
         with self._worker_stats_lock:
             ws_items = sorted(self._worker_stats.items())
-        per_worker: dict = {wid: {"wire": ws.wire.snapshot()}
-                            for wid, ws in ws_items}
+        per_worker: dict = {
+            wid: {"wire": ws.wire.snapshot(),
+                  "last_seen_s": round(now - ws.last_seen, 3)}
+            for wid, ws in ws_items}
         controller = getattr(self._runner, "controller", None)
         if controller is not None:
             for wid, snap in controller.staleness_snapshot().items():
                 per_worker.setdefault(wid, {})["staleness"] = snap
         return {"registry": telemetry.snapshot(),
                 "wire": self.wire.snapshot(),
+                "uptime_s": round(now - self._t_started, 3),
+                "anomalies": telemetry.events(),
                 "per_worker": per_worker}
+
+    def _store_worker_trace(self, worker_id, state):
+        """The ``push_trace`` arm's sink: keep a worker's deposited span ring
+        (latest wins) for :func:`telemetry.collect_cluster_trace`.
+
+        Array columns are DEEP-COPIED out of the message: the zero-copy
+        receive path decodes them as aliases into the connection's recycled
+        buffer, and retaining those aliases for the server's lifetime would
+        pin a largest-message-sized buffer (a multi-MiB gradient push) per
+        worker to keep ~1 MiB of trace data."""
+        if not isinstance(state, dict) or "t0_ns" not in state:
+            raise TypeError("push_trace payload is not a trace-state dict")
+        state = {k: (np.array(v) if isinstance(v, np.ndarray) else v)
+                 for k, v in state.items()}
+        with self._trace_lock:
+            self._worker_traces[worker_id] = state
+
+    def worker_traces(self) -> dict:
+        """``{worker_id: trace-state}`` for every ring workers have pushed
+        (``RemotePSWorker.push_trace``) — the chief-side input of
+        :func:`telemetry.collect_cluster_trace`."""
+        with self._trace_lock:
+            return dict(self._worker_traces)
 
     def _dispatch(self, msg):
         # The wire codec's vocabulary is wider than the protocol's: a peer
@@ -584,19 +755,40 @@ class PSServer:
                 # snapshot + per-worker wire/staleness breakdown to whoever
                 # asks (RemotePSWorker.stats(), dashboards, tests).
                 return ("ok", self.stats_snapshot())
+            if op == "ping":
+                # Clock-offset probe: echo the client's send stamp with this
+                # process's wall clock. No locks, no device work — the reply
+                # must be fast for the NTP midpoint assumption to hold.
+                return ("ok", msg[1], time.time_ns())
+            if op == "trace":
+                # Cluster trace plane: drain this process's span ring to the
+                # caller as a columnar blob (RemotePSWorker.trace()).
+                since = msg[1] if len(msg) > 1 else None
+                return ("ok", telemetry.local_trace_state(since_ns=since))
+            if op == "push_trace":
+                # A worker depositing its own ring (already clock-offset
+                # stamped) for the chief's collect_cluster_trace.
+                self._store_worker_trace(msg[1], msg[2])
+                return ("ok", True)
             return ("error", "PSClientError", f"unknown op {op!r}")
         except Exception as e:  # ship the failure to the worker, keep serving
             return ("error", type(e).__name__, str(e))
 
     def close(self):
+        if self._watchdog is not None:
+            self._watchdog.close()
+            self._watchdog = None
         self._server.shutdown()
         self._server.server_close()
         if self.wire.msgs_received:
             # Aggregate first, then one line per worker: wire traffic next to
-            # the staleness-lag distribution its gate entries observed, so a
-            # skewed worker (all lag at the bound, or 10x the bytes) is
-            # visible in the close summary without grepping its own log.
-            logging.info("PSServer closed: %s", self.wire.format_line())
+            # the staleness-lag distribution its gate entries observed and
+            # the worker's last-seen age, so a skewed worker (all lag at the
+            # bound, 10x the bytes, or long silent) is visible in the close
+            # summary without grepping its own log.
+            now = time.monotonic()
+            logging.info("PSServer closed: %s | up %.1fs",
+                         self.wire.format_line(), now - self._t_started)
             controller = getattr(self._runner, "controller", None)
             stal = controller.staleness_histograms() \
                 if controller is not None else {}
@@ -607,6 +799,7 @@ class PSServer:
                 ws = ws_items.get(wid)
                 if ws is not None:
                     parts.append(ws.wire.format_line())
+                    parts.append(f"last seen {now - ws.last_seen:.1f}s ago")
                 hist = stal.get(wid)
                 if hist is not None and hist.count:
                     parts.append(f"staleness {hist.format_compact()}")
@@ -726,6 +919,10 @@ class RemotePSWorker:
     # wedged pull connection disables overlap rather than wedging the step.
     PREFETCH_TIMEOUT = 30.0
 
+    # Ping round-trips per clock-offset estimate (median across rounds; odd
+    # count so the median is a real sample).
+    CLOCK_PING_ROUNDS = 7
+
     def __init__(self, address, runner, worker_id: int,
                  overlap: Optional[bool] = None):
         self._client = _PSClient(address)
@@ -739,6 +936,11 @@ class RemotePSWorker:
         self._pull_client = _PSClient(address) if overlap else None
         self._prefetch: Optional[_Prefetch] = None
         self._server_has_read_min = True  # optimistic; cleared on unknown-op
+        # Chief-clock offset for this worker's main connection (estimated by
+        # estimate_clock_offset; None until then). ADD to this process's
+        # wall-clock ns to land on the chief's timeline.
+        self.clock_offset_ns: Optional[int] = None
+        self.clock_offset_err_ns: Optional[int] = None
         # Register up front: idempotent for a live slot (the server keeps its
         # count), and for a RETIRED slot — e.g. a Coordinator-relaunched worker
         # reusing its AUTODIST_PROCESS_ID — it re-admits the slot so stepping
@@ -918,11 +1120,60 @@ class RemotePSWorker:
         grepping the chief's log."""
         return self._client.call("stats")[0]
 
+    def estimate_clock_offset(self, rounds: Optional[int] = None):
+        """Estimate the chief-clock offset for this worker: ``rounds`` ping
+        exchanges on the main connection, each yielding an NTP midpoint
+        sample; the median offset and its RTT-bounded uncertainty are stored
+        on the worker (``clock_offset_ns``/``clock_offset_err_ns``) and
+        returned. The cluster trace plane uses the offset to rebase this
+        process's spans onto the chief's timeline
+        (:func:`autodist_tpu.telemetry.cluster.ntp_offset`)."""
+        from autodist_tpu.telemetry import cluster as _cluster
+        samples = []
+        for _ in range(rounds or self.CLOCK_PING_ROUNDS):
+            t0 = time.time_ns()
+            _, server_ns = self._client.call("ping", t0)
+            samples.append((t0, server_ns, time.time_ns()))
+        self.clock_offset_ns, self.clock_offset_err_ns = \
+            _cluster.ntp_offset(samples)
+        return self.clock_offset_ns, self.clock_offset_err_ns
+
+    def trace(self, since_ns: Optional[int] = None) -> dict:
+        """Pull the CHIEF's span ring over the transport (the ``trace``
+        opcode): a columnar trace-state blob
+        (:func:`autodist_tpu.telemetry.cluster.local_trace_state`) ready for
+        ``telemetry.merge_trace_states`` / ``collect_cluster_trace``."""
+        return self._client.call("trace", since_ns)[0]
+
+    def push_trace(self, since_ns: Optional[int] = None) -> int:
+        """Deposit this process's span ring on the chief (the ``push_trace``
+        opcode) so the chief's ``collect_cluster_trace`` can lay it out as
+        this worker's ``pid`` lane. Estimates the clock offset first (once
+        per worker) and stamps it into the blob; returns the span count
+        pushed. Automatic at :meth:`close` under ``AUTODIST_TRACE_PULL=1``."""
+        if self.clock_offset_ns is None:
+            self.estimate_clock_offset()
+        from autodist_tpu.telemetry import cluster as _cluster
+        state = _cluster.local_trace_state(
+            since_ns=since_ns, worker_id=self.worker_id,
+            clock_offset_ns=self.clock_offset_ns)
+        self._client.call("push_trace", self.worker_id, state)
+        return len(state["name_idx"])
+
     @property
     def version(self) -> int:
         return self._client.call("version")[0]
 
     def close(self):
+        from autodist_tpu import const
+        if const.ENV.AUTODIST_TRACE_PULL.val and telemetry.enabled():
+            # Last act on the live connection: leave this worker's timeline
+            # with the chief so the cluster trace has a lane for it even
+            # after the process is gone.
+            try:
+                self.push_trace()
+            except (ConnectionError, OSError, PSClientError) as e:
+                logging.debug("trace push at close failed: %s", e)
         pf, self._prefetch = self._prefetch, None
         if self._pull_client is not None:
             # Closing the socket unblocks an in-flight background pull.

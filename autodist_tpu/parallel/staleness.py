@@ -97,6 +97,24 @@ class StalenessController:
         with self._cond:
             return list(self._steps)
 
+    @property
+    def bound(self):
+        """The staleness bound (``math.inf`` when unbounded/fully async)."""
+        return self._bound
+
+    def live_lags(self) -> Dict[int, int]:
+        """Instantaneous per-worker lag: completed steps ahead of the slowest
+        LIVE worker, for every live worker, under one lock hold. A worker at
+        the bound is parked; a worker at 0 while others sit at the bound is
+        the straggler they are waiting for — the PS watchdog's signal."""
+        with self._cond:
+            live = {i: s for i, s in enumerate(self._steps)
+                    if i not in self._retired}
+        if not live:
+            return {}
+        slowest = min(live.values())
+        return {i: s - slowest for i, s in live.items()}
+
     def _runnable(self, worker_id: int) -> bool:
         live = [s for i, s in enumerate(self._steps) if i not in self._retired]
         return not live or self._steps[worker_id] - min(live) < self._bound
@@ -553,6 +571,30 @@ class AsyncPSRunner(DistributedRunner):
         if self._ps_server is not None:
             return self._ps_server.wire
         return None
+
+    def collect_cluster_trace(self, path: str, since_ns=None) -> str:
+        """Emit the cluster timeline: this process's span ring merged with
+        every span ring remote workers have pushed over the transport
+        (``RemotePSWorker.push_trace`` / ``AUTODIST_TRACE_PULL=1``), one
+        clock-rebased ``pid`` lane per worker, as Chrome trace JSON at
+        ``path`` (:func:`autodist_tpu.telemetry.collect_cluster_trace`).
+
+        On a worker-role process the merge instead carries this worker's own
+        ring plus the chief's, pulled over the ``trace`` opcode — the local
+        lane is labeled with this worker's id and rebased by its estimated
+        chief-clock offset, so the two lanes align exactly like the
+        chief-side merge (the chief's blob is the reference clock)."""
+        rw = self._remote_worker
+        if rw is not None:
+            if rw.clock_offset_ns is None:
+                rw.estimate_clock_offset()
+            local = telemetry.local_trace_state(
+                since_ns, worker_id=rw.worker_id,
+                clock_offset_ns=rw.clock_offset_ns)
+            return telemetry.merge_trace_states(
+                [local, rw.trace(since_ns)], path)
+        return telemetry.collect_cluster_trace(
+            path, server=self._ps_server, since_ns=since_ns)
 
     def close(self):
         """Release transport endpoints (chief's server / worker's client). Called

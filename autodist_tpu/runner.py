@@ -15,6 +15,9 @@ the mesh:
 """
 
 import dataclasses
+import time
+import weakref
+import zlib
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -113,6 +116,35 @@ jax.tree_util.register_dataclass(
     meta_fields=["plan"])
 
 
+class _CompileProbe:
+    """Times one first-of-its-signature dispatch and books it as compilation.
+
+    jit compiles synchronously inside the first call for a new input
+    signature (tracing + lowering + XLA compile happen before the program is
+    enqueued), so that call's wall time IS the compile cost to within one
+    async dispatch. Wraps the would-be dispatch span with a ``jit.compile``
+    span and, on exit, bumps ``jit.cache_miss`` and accumulates
+    ``jit.compile_s`` in the telemetry registry. Constructed only in enabled
+    mode (:meth:`DistributedRunner._dispatch_span`)."""
+
+    __slots__ = ("_inner", "_t0")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._inner.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        telemetry.counter("jit.cache_miss").inc()
+        telemetry.counter("jit.compile_s").inc(dt)
+        return self._inner.__exit__(*exc)
+
+
 class DistributedRunner:
     """Compiles and runs the distributed train step for one (strategy, model).
 
@@ -160,6 +192,16 @@ class DistributedRunner:
         self._many_fns: dict = {}   # fused K-step scans, same keying
         self._eval_fns: dict = {}
         self._state_shardings = None
+        # Dispatch signatures (kind + fetch-fn token + batch shapes/dtypes)
+        # already seen: a NEW signature means jit will retrace+recompile
+        # inside the next call — the compile-telemetry key (_dispatch_span).
+        # Fetch fns get a NEVER-REUSED token via a weak map: a bare id()
+        # could be recycled by a new fn after the old one (evicted from the
+        # step cache) is collected, silently suppressing its compile record.
+        self._compile_sigs: set = set()
+        self._fetch_tokens: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        self._fetch_token_next = 0
 
     def _mesh_from_plan(self) -> Mesh:
         axes = dict(self.plan.mesh_axes)
@@ -541,6 +583,55 @@ class DistributedRunner:
         tree = jax.tree_util.tree_map(put, *batches, is_leaf=_is_micro)
         return BatchBlock(tree, K)
 
+    def _fetch_token(self, fetch_fn) -> str:
+        """A stable, never-reused token for a fetch fn (monotonic counter
+        behind a weak map — a collected fn's token is never handed to a new
+        one, unlike a recycled ``id()``)."""
+        if fetch_fn is None:
+            return "-"
+        try:
+            token = self._fetch_tokens.get(fetch_fn)
+            if token is None:
+                self._fetch_token_next += 1
+                token = self._fetch_tokens[fetch_fn] = self._fetch_token_next
+        except TypeError:          # non-weakref-able callable: best effort
+            return f"id{id(fetch_fn)}"
+        return str(token)
+
+    def _compile_signature(self, kind: str, fetch_fn, batch: PyTree) -> str:
+        """Shape signature of one dispatch: the (kind, fetch-fn token,
+        per-leaf dtype/shape, treedef) tuple jit keys its executable cache
+        by, flattened to a string. Two calls with equal signatures hit the
+        same compiled program; a fresh signature recompiles — which is what
+        the compile telemetry counts."""
+        parts = [kind, self._fetch_token(fetch_fn)]
+        leaves, treedef = jax.tree_util.tree_flatten(batch, is_leaf=_is_micro)
+        parts.append(str(treedef))
+        for leaf in leaves:
+            v = leaf.value if _is_micro(leaf) else leaf
+            parts.append(f"{'m' if _is_micro(leaf) else ''}"
+                         f"{getattr(v, 'dtype', type(v).__name__)}"
+                         f"{getattr(v, 'shape', ())}")
+        return "|".join(parts)
+
+    def _dispatch_span(self, name: str, kind: str, fetch_fn, batch: PyTree,
+                       **span_args):
+        """The span wrapping a compiled-step dispatch. Enabled mode only: the
+        first dispatch of a NEW shape signature becomes a ``jit.compile``
+        span (carrying a crc32 of the signature) whose exit books
+        ``jit.cache_miss``/``jit.compile_s`` — so "why was step N slow"
+        answers itself as "a new batch shape recompiled". Disabled mode
+        short-circuits to the shared no-op span."""
+        if not telemetry.enabled():
+            return telemetry.span(name)
+        sig = self._compile_signature(kind, fetch_fn, batch)
+        if sig in self._compile_sigs:
+            return telemetry.span(name, **span_args)
+        self._compile_sigs.add(sig)
+        return _CompileProbe(telemetry.span(
+            "jit.compile", kind=kind,
+            sig=format(zlib.crc32(sig.encode()), "08x"), **span_args))
+
     def logical_params(self, state_or_params) -> PyTree:
         """The parameter tree at its original (user-facing, unpadded) shapes."""
         params = state_or_params.params if isinstance(state_or_params, TrainState) \
@@ -573,8 +664,11 @@ class DistributedRunner:
         # asynchronous); the wait for results shows up in the caller's
         # readback span (metrics._sync / device_get), and device execution in
         # the jax.profiler trace. A long dispatch span means compilation or a
-        # full dispatch queue.
-        with telemetry.span("runner.run.dispatch"):
+        # full dispatch queue — and the first dispatch of a new shape
+        # signature is recorded AS compilation (jit.compile span +
+        # jit.cache_miss/jit.compile_s counters, see _dispatch_span).
+        with self._dispatch_span("runner.run.dispatch", "step", fetches,
+                                 sharded):
             with self.mesh:
                 new_state, (loss, aux, fetched) = step_fn(state, sharded)
         default = (loss, aux) if self._has_aux else loss
@@ -611,7 +705,8 @@ class DistributedRunner:
         many_fn = self._many_fns.get(fetches)
         if many_fn is None:
             many_fn = self._build_many(fetches)
-        with telemetry.span("runner.run_many.dispatch", steps=block.length):
+        with self._dispatch_span("runner.run_many.dispatch", "many", fetches,
+                                 block.tree, steps=block.length):
             with self.mesh:
                 new_state, (losses, auxes, fetched) = many_fn(state, block.tree)
         default = (losses, auxes) if self._has_aux else losses

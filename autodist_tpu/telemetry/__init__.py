@@ -13,6 +13,11 @@ Three planes, one subsystem (docs/usage/observability.md):
 - **Stats plane** — the PS transport's ``stats`` opcode ships a remote
   process's snapshot to whoever asks
   (:meth:`autodist_tpu.parallel.ps_transport.RemotePSWorker.stats`).
+- **Cluster trace plane** (:mod:`autodist_tpu.telemetry.cluster`) — span
+  rings cross the PS wire (``trace``/``push_trace`` opcodes, ``ping``-based
+  clock-offset estimation) and :func:`collect_cluster_trace` merges them
+  into ONE clock-aligned Chrome trace with a ``pid`` lane per worker;
+  ``tools/tracedump.py`` does the same offline from JSONL ring dumps.
 
 Everything is OFF by default; ``AUTODIST_TELEMETRY=1`` (or
 :func:`telemetry.enable`) turns recording on. Disabled-mode instrumentation
@@ -20,11 +25,18 @@ costs one attribute check per span (gated in ``bench.py
 --telemetry-overhead``).
 """
 
+from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
+                                            dump_spans_jsonl,
+                                            load_trace_jsonl,
+                                            local_trace_state,
+                                            merge_trace_states, ntp_offset)
 from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
-                                           export_chrome_trace)
+                                           export_chrome_trace,
+                                           sample_device_memory)
 from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
-                                            Registry, counter, gauge,
-                                            histogram, registry, snapshot)
+                                            Registry, counter, event, events,
+                                            gauge, histogram, registry,
+                                            snapshot)
 from autodist_tpu.telemetry.spans import (clear, disable, enable, enabled,
                                           snapshot_spans, span, traced)
 
@@ -33,5 +45,9 @@ __all__ = [
     "snapshot_spans",
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "registry", "snapshot",
+    "event", "events",
     "export_chrome_trace", "chrome_trace_events", "emit_metrics",
+    "sample_device_memory",
+    "collect_cluster_trace", "local_trace_state", "merge_trace_states",
+    "dump_spans_jsonl", "load_trace_jsonl", "ntp_offset",
 ]

@@ -1,0 +1,346 @@
+"""Cluster trace plane: clock-aligned multi-worker host timelines.
+
+The span ring (:mod:`autodist_tpu.telemetry.spans`) answers "what did THIS
+process do"; at pod scale the question is "what did the CLUSTER do during one
+step" — which worker's gate wait, input stall, or compile is the bottleneck.
+This module makes span rings portable and mergeable:
+
+- :func:`local_trace_state` snapshots the ring as a COLUMNAR, wire-encodable
+  blob (name/tid tables + int32 index columns + int64 ``t0_ns``/``dur_ns``
+  arrays): 65536 spans ship as a handful of large ndarrays the zero-copy PS
+  wire frames without per-span Python encoding — that is what keeps a
+  full-ring pull off the chief's critical path (``bench.py
+  --trace-pull-overhead`` gates it).
+- The PS transport's ``trace`` opcode serves this blob on demand and
+  ``push_trace`` lets a worker deposit its own ring on the chief
+  (:mod:`autodist_tpu.parallel.ps_transport`); ``ping`` round-trips feed
+  :func:`ntp_offset`, the NTP-style chief-clock offset estimate each worker
+  stores per connection.
+- :func:`collect_cluster_trace` (chief) / :func:`merge_trace_states` merge
+  any set of blobs into ONE Chrome trace-event file with a ``pid`` lane per
+  worker, every lane rebased onto the chief's wall clock via each blob's
+  ``clock_offset_ns`` — loadable in Perfetto beside a ``jax.profiler``
+  device trace.
+- :func:`dump_spans_jsonl` / :func:`load_trace_jsonl` are the offline path:
+  per-worker JSONL ring dumps that ``tools/tracedump.py`` merges after the
+  run, when no transport was up to push through.
+
+Clock model: spans are stamped with ``time.perf_counter_ns`` (monotonic,
+process-local origin). Each blob carries one ``(wall_ns, perf_ns)`` pair
+sampled back-to-back under the ring lock, so a span's wall-clock start is
+``wall_ns + (t0_ns - perf_ns)``; adding the blob's ``clock_offset_ns``
+(estimated as chief-clock minus local-clock, see :func:`ntp_offset`) lands it
+on the chief's timeline. Offset uncertainty is bounded by half the best
+observed ping RTT — microseconds on loopback, sub-millisecond on a pod's
+DCN, far below the millisecond-scale spans the plane exists to compare.
+"""
+
+import json
+import socket
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autodist_tpu.telemetry import spans as _spans
+from autodist_tpu.utils import logging
+
+__all__ = ["local_trace_state", "ntp_offset", "trace_state_events",
+           "merge_trace_states", "collect_cluster_trace", "dump_spans_jsonl",
+           "load_trace_jsonl"]
+
+# Trace-blob schema version (bumped on layout changes so an old tracedump
+# rejects a new dump instead of misreading it).
+TRACE_STATE_VERSION = 1
+
+_PLAIN = frozenset((str, int, float, bool, type(None)))
+
+
+def _sanitize_args(args: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Span args restricted to wire/JSON-safe scalars (anything else rides as
+    ``str(value)`` — a span arg must never make a ring unshippable)."""
+    if not args:
+        return None
+    return {str(k): (v if type(v) in _PLAIN else str(v))
+            for k, v in args.items()}
+
+
+def _args_json(args_map: Dict[int, Dict[str, Any]]) -> str:
+    """The sparse ``{span_index: args}`` map as ONE JSON string: C-speed
+    serialization instead of thousands of nested wire dicts (a full-ring
+    blob with per-step annotations would otherwise dominate the pull's
+    chief-side stall — the ``bench.py --trace-pull-overhead`` gate)."""
+    try:
+        return json.dumps(args_map, default=str)
+    except (TypeError, ValueError):
+        # Pathological args (non-str/int dict keys etc.): sanitize per entry.
+        return json.dumps({i: _sanitize_args(a)
+                           for i, a in args_map.items()}, default=str)
+
+
+def _parse_args_json(state: Dict[str, Any]) -> Dict[int, Dict[str, Any]]:
+    """The blob's sparse args map with int span indices restored (JSON and
+    the typed wire both stringify/accept the keys differently)."""
+    raw = state.get("args_json")
+    parsed = json.loads(raw) if raw else {}
+    return {int(k): v for k, v in parsed.items()}
+
+
+def local_trace_state(since_ns: Optional[int] = None,
+                      worker_id: Optional[int] = None,
+                      clock_offset_ns: int = 0) -> Dict[str, Any]:
+    """Snapshot this process's span ring as a wire-encodable columnar blob.
+
+    ``since_ns`` (a ``perf_counter_ns`` stamp) keeps only spans started
+    at/after it; ``worker_id`` labels the blob's lane in a merged trace;
+    ``clock_offset_ns`` is the chief-minus-local clock offset the holder
+    estimated (0 for the chief itself). Columns: ``names``/``tids`` are
+    de-duplicated tables, ``name_idx``/``tid_idx`` int32 index columns,
+    ``t0_ns``/``dur_ns`` int64, sparse span args as one JSON string. The
+    span ring is stored columnar with interned ids
+    (:mod:`autodist_tpu.telemetry.spans`), so a full 65536-span ring
+    snapshots + encodes in tens of milliseconds — no per-span Python tuples
+    anywhere between the ring and the wire — which is what keeps a live
+    trace pull off the chief's critical path (``bench.py
+    --trace-pull-overhead`` gates it)."""
+    (pid, epoch_ns, names, tids, name_idx, tid_idx, t0s, durs, args,
+     thread_names, wall_ns, perf_ns) = _spans._export_columns(since_ns)
+    return {
+        "v": TRACE_STATE_VERSION,
+        "pid": pid,
+        "host": socket.gethostname(),
+        "worker_id": worker_id,
+        "wall_ns": wall_ns,
+        "perf_ns": perf_ns,
+        "epoch_ns": epoch_ns,
+        "clock_offset_ns": int(clock_offset_ns),
+        "names": names,
+        "name_idx": np.array(name_idx, np.int32),
+        "tids": tids,
+        "tid_idx": np.array(tid_idx, np.int32),
+        "t0_ns": np.array(t0s, np.int64),
+        "dur_ns": np.array(durs, np.int64),
+        "args_json": _args_json({i: a for i, a in enumerate(args) if a}),
+        "thread_names": {int(t): nm for t, nm in thread_names.items()},
+    }
+
+
+def ntp_offset(samples: Sequence[Tuple[int, int, int]]) -> Tuple[int, int]:
+    """NTP-style clock offset from ping round-trips.
+
+    ``samples`` holds ``(t0_ns, server_ns, t1_ns)`` per round trip: the
+    caller's wall clock at send and receive bracketing the server's wall
+    stamp. Assuming symmetric delay, the server's clock leads the caller's by
+    ``server_ns - (t0 + t1) / 2``; the MEDIAN across rounds rejects the
+    odd delayed exchange. Returns ``(offset_ns, uncertainty_ns)`` where the
+    uncertainty is half the best observed RTT — the worst-case error a fully
+    asymmetric path could hide inside the tightest round trip."""
+    if not samples:
+        raise ValueError("ntp_offset needs at least one (t0, server, t1) sample")
+    offsets = sorted(s_ns - (t0 + t1) // 2 for t0, s_ns, t1 in samples)
+    rtt_min = min(t1 - t0 for t0, _, t1 in samples)
+    return offsets[len(offsets) // 2], max(0, rtt_min // 2)
+
+
+def _wall_starts(state: Dict[str, Any]) -> np.ndarray:
+    """Per-span chief-timeline wall-clock starts (ns) for one blob."""
+    base = (int(state["wall_ns"]) - int(state["perf_ns"])
+            + int(state.get("clock_offset_ns", 0)))
+    return np.asarray(state["t0_ns"], np.int64) + base
+
+
+def _lane_label(state: Dict[str, Any]) -> str:
+    wid = state.get("worker_id")
+    who = "chief" if wid is None else f"worker {wid}"
+    return f"{who} ({state.get('host', '?')} pid {state.get('pid', '?')})"
+
+
+def trace_state_events(state: Dict[str, Any], pid: int,
+                       origin_ns: int) -> List[Dict[str, Any]]:
+    """One blob as Chrome trace events on lane ``pid``: an ``M``
+    process_name event, ``M`` thread_name events, then one ``X`` (complete)
+    event per span with ``ts``/``dur`` in microseconds relative to
+    ``origin_ns`` (a chief-timeline wall stamp)."""
+    events: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": _lane_label(state)}}]
+    tids = [int(t) for t in state["tids"]]
+    thread_names = {int(t): nm
+                    for t, nm in dict(state.get("thread_names", {})).items()}
+    for tid in sorted(set(tids)):
+        nm = thread_names.get(tid)
+        if nm:
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": nm}})
+    names = list(state["names"])
+    name_idx = np.asarray(state["name_idx"], np.int64)
+    tid_idx = np.asarray(state["tid_idx"], np.int64)
+    dur_ns = np.asarray(state["dur_ns"], np.int64)
+    starts = _wall_starts(state)
+    args_map = _parse_args_json(state)
+    for i in range(len(name_idx)):
+        events.append({
+            "name": names[name_idx[i]],
+            "ph": "X",
+            "cat": "host",
+            "ts": float(int(starts[i]) - origin_ns) / 1e3,
+            "dur": float(dur_ns[i]) / 1e3,
+            "pid": pid,
+            "tid": tids[tid_idx[i]],
+            "args": args_map.get(i) or {},
+        })
+    return events
+
+
+def _assign_pid(state: Dict[str, Any], used: set) -> int:
+    """Deterministic lane id: chief -> 0, worker w -> w + 1, collisions walk
+    to the next free id (two blobs from the same worker id stay distinct)."""
+    wid = state.get("worker_id")
+    pid = 0 if wid is None else int(wid) + 1
+    while pid in used:
+        pid += 1
+    used.add(pid)
+    return pid
+
+
+def merge_trace_states(states: Iterable[Dict[str, Any]],
+                       path: str) -> str:
+    """Merge trace blobs into ONE Chrome trace file at ``path``.
+
+    Every blob's spans are rebased onto the chief wall clock
+    (``wall + clock_offset_ns``); the merged origin is the earliest rebased
+    span start across all lanes, so the file opens at t=0 in Perfetto.
+    Returns ``path``."""
+    states = list(states)
+    for st in states:
+        v = st.get("v", TRACE_STATE_VERSION)
+        if v != TRACE_STATE_VERSION:
+            raise ValueError(f"trace state version {v} is not supported "
+                             f"(this build reads v{TRACE_STATE_VERSION})")
+    origins = [int(_wall_starts(st).min()) for st in states
+               if len(np.asarray(st["t0_ns"])) > 0]
+    origin_ns = min(origins) if origins else 0
+    events: List[Dict[str, Any]] = []
+    used: set = set()
+    for st in states:
+        events.extend(trace_state_events(st, _assign_pid(st, used), origin_ns))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    n_spans = sum(1 for ev in events if ev["ph"] == "X")
+    logging.info("Wrote cluster trace: %d span(s) across %d lane(s) to %s",
+                 n_spans, len(states), path)
+    return path
+
+
+def collect_cluster_trace(path: str, server=None, peers: Iterable = (),
+                          since_ns: Optional[int] = None,
+                          include_local: bool = True) -> str:
+    """Emit ONE clock-aligned Chrome trace for the cluster at ``path``.
+
+    Lanes, in order:
+
+    - this process's own ring (``include_local``, offset 0 — the caller IS
+      the timeline's reference clock; on the chief that is exactly right),
+    - every blob pulled from ``peers`` — objects with a
+      ``trace(since_ns)`` method, e.g. a
+      :class:`~autodist_tpu.parallel.ps_transport.RemotePSWorker` pulling
+      the chief's ring from a worker process,
+    - every blob workers PUSHED to ``server`` (a
+      :class:`~autodist_tpu.parallel.ps_transport.PSServer`; workers deposit
+      their rings via ``RemotePSWorker.push_trace()``, automatic at close
+      under ``AUTODIST_TRACE_PULL=1``), already carrying each pusher's
+      estimated chief-clock offset.
+
+    ``AsyncPSRunner.collect_cluster_trace(path)`` is the chief-side
+    convenience wrapper that passes its own PSServer. Load the file in
+    ui.perfetto.dev next to a ``jax.profiler`` device trace; each worker is
+    its own ``pid`` lane."""
+    states: List[Dict[str, Any]] = []
+    if include_local:
+        states.append(local_trace_state(since_ns))
+    for peer in peers:
+        states.append(peer.trace(since_ns))
+    if server is not None:
+        for _, st in sorted(server.worker_traces().items(), key=lambda kv:
+                            (str(kv[0]))):
+            states.append(st)
+    return merge_trace_states(states, path)
+
+
+def dump_spans_jsonl(path: str, worker_id: Optional[int] = None,
+                     since_ns: Optional[int] = None,
+                     clock_offset_ns: int = 0) -> str:
+    """Dump this process's span ring as JSONL for offline merging.
+
+    Line 1 is the blob's metadata (``{"meta": {...}}``); every following
+    line is one span ``[name, tid, t0_ns, dur_ns, args]``. The offline
+    counterpart of the ``trace``/``push_trace`` wire path — each worker
+    dumps its own file, ``tools/tracedump.py`` merges them afterwards."""
+    state = local_trace_state(since_ns, worker_id=worker_id,
+                              clock_offset_ns=clock_offset_ns)
+    meta = {k: state[k] for k in ("v", "pid", "host", "worker_id", "wall_ns",
+                                  "perf_ns", "epoch_ns", "clock_offset_ns",
+                                  "thread_names")}
+    names = state["names"]
+    tids = state["tids"]
+    args_map = _parse_args_json(state)
+    with open(path, "w") as f:
+        f.write(json.dumps({"meta": meta}) + "\n")
+        for i in range(len(state["name_idx"])):
+            f.write(json.dumps([names[state["name_idx"][i]],
+                                tids[state["tid_idx"][i]],
+                                int(state["t0_ns"][i]),
+                                int(state["dur_ns"][i]),
+                                args_map.get(i)]) + "\n")
+    return path
+
+
+def load_trace_jsonl(path: str,
+                     clock_offset_ns: Optional[int] = None) -> Dict[str, Any]:
+    """Load a :func:`dump_spans_jsonl` file back into a trace blob;
+    ``clock_offset_ns`` overrides the dumped offset (the ``tracedump
+    --offset`` hook for dumps written before an offset was known)."""
+    with open(path) as f:
+        header = json.loads(f.readline())
+        if not isinstance(header, dict) or "meta" not in header:
+            raise ValueError(f"{path}: not a span JSONL dump (no meta line)")
+        meta = header["meta"]
+        if meta.get("v", TRACE_STATE_VERSION) != TRACE_STATE_VERSION:
+            raise ValueError(f"{path}: trace dump version {meta.get('v')} is "
+                             f"not supported (this build reads "
+                             f"v{TRACE_STATE_VERSION})")
+        rows = [json.loads(line) for line in f if line.strip()]
+    names: List[str] = []
+    name_ix: Dict[str, int] = {}
+    tids: List[int] = []
+    tid_ix: Dict[int, int] = {}
+    n = len(rows)
+    name_idx = np.empty(n, np.int32)
+    tid_idx = np.empty(n, np.int32)
+    t0_ns = np.empty(n, np.int64)
+    dur_ns = np.empty(n, np.int64)
+    args_map: Dict[int, Dict[str, Any]] = {}
+    for i, (name, tid, t0, dur, args) in enumerate(rows):
+        j = name_ix.get(name)
+        if j is None:
+            j = name_ix[name] = len(names)
+            names.append(name)
+        name_idx[i] = j
+        k = tid_ix.get(tid)
+        if k is None:
+            k = tid_ix[tid] = len(tids)
+            tids.append(int(tid))
+        tid_idx[i] = k
+        t0_ns[i] = t0
+        dur_ns[i] = dur
+        if args:
+            args_map[i] = args
+    state = dict(meta)
+    if clock_offset_ns is not None:
+        state["clock_offset_ns"] = int(clock_offset_ns)
+    state.update(names=names, name_idx=name_idx, tids=tids, tid_idx=tid_idx,
+                 t0_ns=t0_ns, dur_ns=dur_ns,
+                 args_json=_args_json(args_map))
+    state["thread_names"] = {int(t): nm for t, nm in
+                             dict(meta.get("thread_names", {})).items()}
+    return state

@@ -20,16 +20,27 @@ from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.telemetry import spans as _spans
 from autodist_tpu.utils import logging
 
-__all__ = ["export_chrome_trace", "emit_metrics"]
+__all__ = ["export_chrome_trace", "emit_metrics", "sample_device_memory"]
 
 
-def chrome_trace_events(since_ns=None) -> list:
+def chrome_trace_events(since_ns=None, pid: Optional[int] = None,
+                        clock_offset_ns: int = 0) -> list:
     """The recorded spans as a list of Chrome trace-event dicts: one ``"M"``
     thread_name metadata event per recorded thread, then one ``"X"``
     (complete) event per span with microsecond ``ts``/``dur`` relative to the
     ring's epoch. ``since_ns`` (a ``time.perf_counter_ns`` stamp) keeps only
-    spans that started at/after it — the traced-window filter."""
-    pid, epoch_ns, recorded, thread_names = _spans._export_state(since_ns)
+    spans that started at/after it — the traced-window filter.
+
+    ``pid`` overrides the lane id (Chrome groups events by pid, so each
+    worker exporting under its own lane id merges collision-free) and
+    ``clock_offset_ns`` is ADDED to every span timestamp before the µs
+    conversion — together they let per-worker exports land on one shared
+    timeline with no post-hoc JSON rewriting (the cluster trace plane's
+    :mod:`autodist_tpu.telemetry.cluster` computes the offsets)."""
+    real_pid, epoch_ns, recorded, thread_names, _, _ = \
+        _spans._export_state(since_ns)
+    if pid is None:
+        pid = real_pid
     events = []
     for tid, name in sorted(thread_names.items()):
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
@@ -39,7 +50,8 @@ def chrome_trace_events(since_ns=None) -> list:
             "name": name,
             "ph": "X",
             "cat": "host",
-            "ts": (t0_ns - epoch_ns) / 1e3,   # trace-event ts unit: usec
+            # trace-event ts unit: usec
+            "ts": (t0_ns - epoch_ns + clock_offset_ns) / 1e3,
             "dur": dur_ns / 1e3,
             "pid": pid,
             "tid": tid,
@@ -48,18 +60,66 @@ def chrome_trace_events(since_ns=None) -> list:
     return events
 
 
-def export_chrome_trace(path: str, since_ns=None) -> str:
+def export_chrome_trace(path: str, since_ns=None, pid: Optional[int] = None,
+                        clock_offset_ns: int = 0) -> str:
     """Write the span ring buffer to ``path`` as Chrome trace-event JSON;
     returns ``path``. Safe to call repeatedly (each call snapshots the ring);
     an empty ring writes a valid empty trace. ``since_ns`` restricts the
-    export to spans started at/after that ``perf_counter_ns`` stamp."""
-    doc = {"traceEvents": chrome_trace_events(since_ns),
+    export to spans started at/after that ``perf_counter_ns`` stamp; ``pid``
+    and ``clock_offset_ns`` relabel/rebase the lane for merged multi-worker
+    timelines (see :func:`chrome_trace_events`)."""
+    doc = {"traceEvents": chrome_trace_events(since_ns, pid=pid,
+                                              clock_offset_ns=clock_offset_ns),
            "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(doc, f)
     logging.info("Wrote %d host span event(s) to %s",
                  len(doc["traceEvents"]), path)
     return path
+
+
+def sample_device_memory() -> int:
+    """Sample live-buffer and device-memory gauges into the registry; returns
+    the number of gauges written.
+
+    Gauges: ``device.live_buffers`` / ``device.live_bytes`` (count and host
+    view of bytes across ``jax.live_arrays()`` — a leak shows as monotonic
+    growth across log boundaries) and, where the backend reports allocator
+    stats (TPU/GPU; CPU returns none), per-device
+    ``device.mem.bytes_in_use.d<id>`` / ``device.mem.bytes_limit.d<id>``.
+    Called by ``train()`` at log boundaries when telemetry is enabled; a
+    diagnostics sampler must never break training, so backend hiccups are
+    swallowed at debug level."""
+    import jax
+    wrote = 0
+    try:
+        live = jax.live_arrays()
+        _metrics.gauge("device.live_buffers").set(len(live))
+        _metrics.gauge("device.live_bytes").set(
+            int(sum(int(getattr(a, "nbytes", 0) or 0) for a in live)))
+        wrote += 2
+    except (RuntimeError, ValueError, TypeError, AttributeError) as e:
+        logging.debug("live-array sampling unavailable: %s", e)
+    try:
+        devices = jax.local_devices()
+    except RuntimeError as e:  # backend not initialized yet
+        logging.debug("device-memory sampling unavailable: %s", e)
+        return wrote
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except (RuntimeError, ValueError, TypeError, AttributeError):
+            stats = None
+        if not stats:
+            continue
+        for key, gauge_name in (("bytes_in_use", "bytes_in_use"),
+                                ("bytes_limit", "bytes_limit")):
+            value = stats.get(key)
+            if value is not None:
+                _metrics.gauge(
+                    f"device.mem.{gauge_name}.d{d.id}").set(int(value))
+                wrote += 1
+    return wrote
 
 
 _EMIT_LOGGER = None

@@ -15,11 +15,13 @@ assume.
 """
 
 import bisect
+import collections
 import threading
-from typing import Dict, Optional, Sequence, Tuple, Union
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
-           "counter", "gauge", "histogram", "snapshot"]
+           "counter", "gauge", "histogram", "snapshot", "event", "events"]
 
 Number = Union[int, float]
 
@@ -134,11 +136,24 @@ class Histogram:
         return "{" + body + "}"
 
 
+# Structured events kept per registry (newest win; anomaly records from the
+# PS watchdog, not a general log sink).
+_EVENT_RING = 256
+
+
 class Registry:
-    """Named get-or-create instrument store with a deterministic snapshot."""
+    """Named get-or-create instrument store with a deterministic snapshot.
+
+    Besides instruments, a registry keeps a bounded ring of STRUCTURED
+    EVENTS (:meth:`event`) — discrete anomaly records like the PS watchdog's
+    straggler flags, where a counter says "how many" but not "which worker,
+    when". Events carry wall-clock timestamps, so they live OUTSIDE
+    :meth:`snapshot` (which stays deterministic for a given set of recorded
+    values); ship them explicitly via :meth:`events`."""
 
     def __init__(self):
         self._metrics: Dict[str, object] = {}
+        self._events = collections.deque(maxlen=_EVENT_RING)
         self._lock = threading.Lock()
 
     def _get(self, name: str, cls, *args):
@@ -170,11 +185,28 @@ class Registry:
             items = sorted(self._metrics.items())
         return {name: m.snapshot() for name, m in items}
 
+    def event(self, name: str, **fields) -> Dict[str, object]:
+        """Record a structured event (``{"name", "t_wall_s", **fields}``) into
+        the bounded event ring; returns the record. Field values must be
+        wire-encodable plain data (the stats plane ships events verbatim)."""
+        rec: Dict[str, object] = {"name": name,
+                                  "t_wall_s": round(time.time(), 3)}
+        rec.update(fields)
+        with self._lock:
+            self._events.append(rec)
+        return rec
+
+    def events(self) -> List[Dict[str, object]]:
+        """A point-in-time copy of the event ring, oldest first."""
+        with self._lock:
+            return list(self._events)
+
     def clear(self):
-        """Drop every instrument (tests; production registries live for the
-        process)."""
+        """Drop every instrument and event (tests; production registries live
+        for the process)."""
         with self._lock:
             self._metrics.clear()
+            self._events.clear()
 
 
 _REGISTRY = Registry()
@@ -199,3 +231,11 @@ def histogram(name: str, buckets: Optional[Sequence[Number]] = None) -> Histogra
 
 def snapshot() -> Dict[str, object]:
     return _REGISTRY.snapshot()
+
+
+def event(name: str, **fields) -> Dict[str, object]:
+    return _REGISTRY.event(name, **fields)
+
+
+def events() -> List[Dict[str, object]]:
+    return _REGISTRY.events()

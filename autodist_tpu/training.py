@@ -188,6 +188,9 @@ def train(runner, params: PyTree,
                              meter.last_readback_s,
                              f" | {stats.format_line()}" if stats else "")
                 if telemetry.enabled():
+                    # Memory gauges first so the snapshot emitted below
+                    # carries this boundary's live-buffer/HBM readings.
+                    telemetry.sample_device_memory()
                     telemetry.emit_metrics(global_step=step_i + 1)
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
@@ -299,6 +302,9 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                              step_i, last, rate, queue_depth,
                              meter.last_readback_s)
                 if telemetry.enabled():
+                    # Memory gauges first so the emitted snapshot carries
+                    # this boundary's live-buffer/HBM readings.
+                    telemetry.sample_device_memory()
                     telemetry.emit_metrics(global_step=step_i)
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)

@@ -11,13 +11,17 @@ Parity with reference §5.1:
 
 ``trace(..., with_host_spans=True)`` additionally records the host-side
 telemetry spans (:mod:`autodist_tpu.telemetry`) for the traced window and
-writes them as ``host_spans.json`` inside the same trace directory — open the
-profiler's ``*.trace.json.gz`` and ``host_spans.json`` together in
-ui.perfetto.dev (Perfetto merges multiple opened files into one timeline) to
-see host dispatch/wait spans next to device execution. The two traces use
-different clock origins, so align on a recognizable boundary (e.g. the first
+writes them as ``host_spans_w<process-id>.json`` inside the same trace
+directory (the AUTODIST_PROCESS_ID suffix keeps per-worker files on a shared
+trace dir from overwriting each other) — open the profiler's
+``*.trace.json.gz`` and the host-span file(s) together in ui.perfetto.dev
+(Perfetto merges multiple opened files into one timeline) to see host
+dispatch/wait spans next to device execution. The two traces use different
+clock origins, so align on a recognizable boundary (e.g. the first
 ``runner.run.dispatch`` span vs the first device program) rather than
-absolute timestamps; see docs/usage/observability.md.
+absolute timestamps; for a CLOCK-ALIGNED multi-worker host timeline use
+``telemetry.collect_cluster_trace`` / ``tools/tracedump.py`` instead; see
+docs/usage/observability.md.
 """
 
 import contextlib
@@ -49,9 +53,11 @@ def trace(name: str = "trace", trace_dir: Optional[str] = None,
     Produces a Perfetto-compatible trace viewable in TensorBoard or ui.perfetto.dev
     (the chrome-trace timeline counterpart). With ``with_host_spans=True``,
     telemetry span recording is enabled for the window and the host timeline
-    is written to ``<trace_dir>/host_spans.json`` on exit (telemetry returns
-    to its prior enabled/disabled state afterwards) — load both files in
-    Perfetto for a host+device overlay (see module docstring)."""
+    is written to ``<trace_dir>/host_spans_w<process-id>.json`` on exit
+    (telemetry returns to its prior enabled/disabled state afterwards; the
+    per-process name keeps workers sharing a trace dir from colliding) —
+    load both files in Perfetto for a host+device overlay (see module
+    docstring)."""
     import jax
     trace_dir = trace_dir or _unique_trace_dir(name)
     os.makedirs(trace_dir, exist_ok=True)
@@ -72,7 +78,9 @@ def trace(name: str = "trace", trace_dir: Optional[str] = None,
             if not was_enabled:
                 telemetry.disable()
             telemetry.export_chrome_trace(
-                os.path.join(trace_dir, "host_spans.json"),
+                os.path.join(
+                    trace_dir,
+                    f"host_spans_w{const.ENV.AUTODIST_PROCESS_ID.val}.json"),
                 since_ns=window_start_ns)
 
 

@@ -268,6 +268,118 @@ def telemetry_overhead(steps: int = 150):
     return result
 
 
+def trace_pull_overhead(rounds: int = 5):
+    """Cluster-trace pull cost micro-bench: fill the span ring to its full
+    capacity (AUTODIST_TELEMETRY_RING, default 65536 spans) and measure
+
+    - ``stall_ms`` — the CHIEF-SIDE blocking work of serving one ``trace``
+      opcode: columnar ring snapshot (``telemetry.local_trace_state``) +
+      zero-copy wire encode. This is the piece that competes with training
+      for the chief's GIL/CPU, so it is the gated number: the recorded
+      ``trace_pull`` row in PERF_BASELINE.json carries ``max_stall_ms``
+      (50.0) — a full-ring pull must never stall training longer than that.
+    - ``pull_ms`` — a worker's full round-trip (request, snapshot, encode,
+      loopback socket, alias decode) against a real PSServer over a
+      numpy-only stub runner, for the end-to-end picture.
+
+    Pure host/CPU work; the columnar blob layout (name/tid tables + ndarray
+    columns instead of 65536 per-span tuples) is exactly what this bench
+    exists to defend."""
+    import sys
+
+    from autodist_tpu import const, telemetry
+    from autodist_tpu.parallel import wire
+
+    cap = int(const.ENV.AUTODIST_TELEMETRY_RING.val)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    telemetry.clear()
+    for i in range(cap):
+        # Every 8th span carries args: realistic rings are mostly bare spans
+        # with occasional annotated ones.
+        if i & 7:
+            with telemetry.span("bench.fill"):
+                pass
+        else:
+            with telemetry.span("bench.fill", step=i):
+                pass
+
+    # Chief-side blocking cost: snapshot + encode (what the serving thread
+    # does while training shares the process). MIN across rounds: the
+    # intrinsic cost is what the gate defends; host-load spikes on a shared
+    # CI box are not trace-plane regressions.
+    stall_samples = []
+    blob_bytes = 0
+    for _ in range(max(rounds, 7)):
+        t0 = time.perf_counter()
+        state = telemetry.local_trace_state()
+        parts = wire.encode_parts(("ok", state))
+        stall_samples.append((time.perf_counter() - t0) * 1e3)
+        blob_bytes = sum(len(p) for p in parts)
+    stall_ms = min(stall_samples)
+
+    # End-to-end loopback pull through a real PSServer.
+    class _StubPSRunner:
+        def __init__(self):
+            from autodist_tpu.parallel.staleness import (ParameterService,
+                                                         StalenessController)
+            from autodist_tpu.runner import TrainState
+            state = TrainState(step=np.zeros((), np.int32),
+                               params={"w": np.ones((8,), np.float32)},
+                               opt_state=(), ef_state=())
+            self.service = ParameterService(state, lambda s, g: s)
+            self.controller = StalenessController(1, staleness=1)
+
+        def add_worker(self, worker_id=None, with_generation=False):
+            wid, gen = self.controller.register_with_generation(worker_id)
+            handle = type("H", (), {"worker_id": wid})()
+            return (handle, gen) if with_generation else handle
+
+    from autodist_tpu.parallel.ps_transport import PSServer, RemotePSWorker
+    server = PSServer(_StubPSRunner(), host="127.0.0.1", watchdog=False)
+    remote = RemotePSWorker("%s:%d" % server.address, runner=None,
+                            worker_id=0, overlap=False)
+    try:
+        remote.trace()                      # warmup
+        pull_samples = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            blob = remote.trace()
+            pull_samples.append((time.perf_counter() - t0) * 1e3)
+        n_spans = len(blob["name_idx"])
+    finally:
+        remote.close()
+        server.close()
+        telemetry.clear()
+        if not was_enabled:
+            telemetry.disable()
+    pull_ms = sorted(pull_samples)[len(pull_samples) // 2]
+
+    result = {
+        "metric": f"trace_pull ({n_spans}-span ring, "
+                  f"{blob_bytes / 2**20:.2f} MiB blob)",
+        "unit": "ms",
+        "rows": {"stall_ms": round(stall_ms, 2), "pull_ms": round(pull_ms, 2)},
+        "ring": n_spans,
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("trace_pull")
+        if recorded:
+            max_stall = recorded.get("max_stall_ms", 50.0)
+            if stall_ms > max_stall:
+                print(f"WARNING: full-ring trace snapshot+encode took "
+                      f"{stall_ms:.1f}ms, over the {max_stall}ms stall gate — "
+                      f"a trace pull would stall training (see "
+                      f"PERF_BASELINE.json trace_pull; did the columnar blob "
+                      f"layout regress to per-span encoding?)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    return result
+
+
 def unroll_sweep(factors):
     """Measure the fused multi-step path (``runner.run_many``) at each unroll
     factor and print ONE JSON line with the steps/s curve.
@@ -389,6 +501,12 @@ def main(argv=None):
              "row in PERF_BASELINE.json (disabled mode must stay within "
              "max_disabled_overhead_pct of step time)")
     parser.add_argument(
+        "--trace-pull-overhead", action="store_true",
+        help="measure the cluster trace plane's pull cost: fill the span "
+             "ring to capacity, report the chief-side snapshot+encode stall "
+             "and the loopback round-trip of one `trace` opcode pull, gated "
+             "against max_stall_ms in the PERF_BASELINE.json trace_pull row")
+    parser.add_argument(
         "--profile", type=int, default=0, metavar="N",
         help="dump a jax.profiler trace (Perfetto/TensorBoard format) of an "
              "N-step window after warmup; the trace directory is reported in "
@@ -399,6 +517,9 @@ def main(argv=None):
         return
     if args.telemetry_overhead:
         telemetry_overhead()
+        return
+    if args.trace_pull_overhead:
+        trace_pull_overhead()
         return
     if args.unroll:
         try:

@@ -95,6 +95,9 @@ python bench.py --wire
 # Telemetry cost gate: disabled-mode span overhead must stay within
 # max_disabled_overhead_pct (PERF_BASELINE.json telemetry_overhead row).
 python bench.py --telemetry-overhead
+# Cluster trace plane gate: a full-ring `trace` pull's chief-side
+# snapshot+encode must stay under max_stall_ms (trace_pull row).
+python bench.py --trace-pull-overhead
 python bench.py
 
 echo "=== CI OK ==="

@@ -87,12 +87,16 @@ def test_traced_decorator_records_per_call():
 
 def test_span_ring_is_bounded():
     telemetry.enable()
-    cap = tspans._STATE.ring.maxlen
+    cap = tspans._STATE.ring_t0.maxlen
     assert cap is not None and cap >= 1
     for i in range(min(cap, 1000) + 50):
         with telemetry.span("s"):
             pass
-    assert len(tspans._STATE.ring) <= cap
+    assert tspans._STATE.ring_len() <= cap
+    # The five ring columns evict in lockstep — they can never misalign.
+    st = tspans._STATE
+    assert len(st.ring_name) == len(st.ring_tid) == len(st.ring_t0) \
+        == len(st.ring_dur) == len(st.ring_args)
 
 
 def test_chrome_trace_export_schema(tmp_path):
@@ -119,6 +123,13 @@ def test_chrome_trace_export_schema(tmp_path):
     assert sorted(names) == ["a", "b"]
     arg_ev = next(ev for ev in events if ev["name"] == "a")
     assert arg_ev["args"] == {"step": 3}
+    # pid/clock_offset_ns parameters (cluster trace plane): same schema, the
+    # lane relabeled and every ts uniformly shifted — defaults unchanged.
+    shifted = telemetry.chrome_trace_events(pid=9, clock_offset_ns=1_000)
+    assert all(ev["pid"] == 9 for ev in shifted)
+    for ev, base_ev in zip((e for e in shifted if e["ph"] == "X"),
+                           (e for e in events if e["ph"] == "X")):
+        assert ev["ts"] - base_ev["ts"] == pytest.approx(1.0)  # 1000ns = 1µs
 
 
 def test_disabled_span_is_single_attribute_check():

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""tracedump — merge per-worker host-span JSONL dumps into one Chrome trace.
+
+The offline half of the cluster trace plane
+(``autodist_tpu/telemetry/cluster.py``): when no PS transport was up to
+``push_trace`` through — single-process debugging, a run that crashed before
+collection, or logs copied off a pod — each worker's
+``telemetry.dump_spans_jsonl(path, worker_id=..)`` file can still be merged
+after the fact into the same clock-aligned, pid-lane-per-worker timeline
+``telemetry.collect_cluster_trace`` produces online.
+
+Usage:
+    python tools/tracedump.py out.json w0.jsonl w1.jsonl [w2.jsonl ...]
+    python tools/tracedump.py out.json *.jsonl --offset 1:250000 --offset 2:-80000
+
+``--offset WID:NS`` overrides a dump's recorded chief-clock offset
+(nanoseconds to ADD to that worker's wall clock) — for dumps written before
+any offset was estimated. Load the output in ui.perfetto.dev or
+chrome://tracing.
+"""
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def _parse_offset(spec: str):
+    try:
+        wid, ns = spec.split(":", 1)
+        return int(wid), int(ns)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--offset wants WID:NANOSECONDS, got {spec!r}")
+
+
+def merge_dumps(out_path: str, inputs, offsets=None) -> str:
+    """Merge span JSONL dumps at ``inputs`` into one Chrome trace at
+    ``out_path``; ``offsets`` maps worker id -> clock_offset_ns override.
+    Returns ``out_path`` (the test-facing entry point — main() is argv
+    plumbing around it)."""
+    from autodist_tpu.telemetry import cluster
+    offsets = offsets or {}
+    states = []
+    for path in inputs:
+        state = cluster.load_trace_jsonl(path)
+        wid = state.get("worker_id")
+        if wid in offsets:
+            state["clock_offset_ns"] = offsets[wid]
+        states.append(state)
+    return cluster.merge_trace_states(states, out_path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tracedump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("out", help="output Chrome trace JSON path")
+    ap.add_argument("inputs", nargs="+",
+                    help="per-worker span JSONL dumps "
+                         "(telemetry.dump_spans_jsonl files)")
+    ap.add_argument("--offset", action="append", type=_parse_offset,
+                    default=[], metavar="WID:NS",
+                    help="override worker WID's chief-clock offset "
+                         "(ns to add; repeatable)")
+    args = ap.parse_args(argv)
+    try:
+        merge_dumps(args.out, args.inputs, offsets=dict(args.offset))
+    except (OSError, ValueError) as e:
+        print(f"tracedump: {e}", file=sys.stderr)
+        return 1
+    print(f"tracedump: wrote {args.out} ({len(args.inputs)} lane(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
