@@ -171,7 +171,8 @@ class AutoDist:
                                    has_aux: bool = False,
                                    num_workers: Optional[int] = None,
                                    accumulation_steps: int = 1,
-                                   batch_size: Optional[int] = None) -> DistributedRunner:
+                                   batch_size: Optional[int] = None,
+                                   zero: Optional[Any] = None) -> DistributedRunner:
         """Compile the strategy for this model and return the runner
         (reference autodist.py:191-198 returned the wrapped session).
 
@@ -185,6 +186,13 @@ class AutoDist:
         or a single slot on single-node runs — an in-process phantom worker that
         never steps would deadlock the staleness gate. Pass it explicitly when
         driving multiple in-process worker handles.
+
+        ``zero`` enables ZeRO-style weight-update sharding (default: the
+        ``AUTODIST_ZERO`` flag): the synchronous runner shards optimizer state
+        and the update over the data-parallel axes (reduce-scatter ->
+        shard-local update -> all-gather); the async regime shards the chief's
+        server-side apply over N concurrent param shards (``zero=N``). See
+        docs/usage/performance.md "Weight-update sharding (ZeRO)".
         """
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
         # Builders that model memory (AutoStrategy) get the session's optimizer
@@ -219,14 +227,15 @@ class AutoDist:
             runner = AsyncPSRunner(compiled, model_spec, loss_fn, optimizer,
                                    has_aux=has_aux, num_workers=workers, plan=plan,
                                    ps_address=getattr(self, "_ps_address", None)
-                                   or (const.ENV.AUTODIST_PS_ADDR.val or None))
+                                   or (const.ENV.AUTODIST_PS_ADDR.val or None),
+                                   zero=zero)
             runner._ps_listen_sock = getattr(self, "_ps_listen_sock", None)
             self._session = runner  # _teardown closes its transport endpoints
             return runner
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
                                  has_aux=has_aux, plan=plan,
                                  accumulation_steps=accumulation_steps,
-                                 batch_size=batch_size)
+                                 batch_size=batch_size, zero=zero)
 
     def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
         if sparse_names is not None:
@@ -239,7 +248,8 @@ class AutoDist:
     def function(self, loss_fn: Callable, params: Any, optimizer,
                  example_batch: Any = None, sparse_names: Optional[Sequence[str]] = None,
                  has_aux: bool = False, accumulation_steps: int = 1,
-                 batch_size: Optional[int] = None) -> Callable:
+                 batch_size: Optional[int] = None,
+                 zero: Optional[Any] = None) -> Callable:
         """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
         internally (reference autodist.py:252-289 cached a built runner the same
         way: first call builds, later calls reuse).
@@ -250,7 +260,8 @@ class AutoDist:
         in-process phantom worker that never steps would deadlock the gate)."""
         runner = self.create_distributed_session(
             loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
-            accumulation_steps=accumulation_steps, batch_size=batch_size)
+            accumulation_steps=accumulation_steps, batch_size=batch_size,
+            zero=zero)
         state = runner.init(params)
 
         def step(batch, fetches=None):

@@ -33,6 +33,15 @@ Two formats, detected on restore:
 
 Optimizer state is saved under an ``__opt__/`` prefix, compressor state under
 ``__ef__/``, the step counter under ``__step__`` (v1) / the manifest (v2).
+ZeRO weight-update sharding (``DistributedRunner(zero=...)``) checkpoints
+transparently in both formats: single-process saves gather each sharded
+optimizer-moment leaf to its full logical shape on the host (``device_get``
+assembles addressable shards — gather-on-save), multi-process saves write the
+v2 per-shard slices; restore reshards per the READING runner's plan, so an
+unsharded checkpoint restores into a ZeRO run and vice versa (pinned by
+``tests/test_zero_update.py``). The async-PS sharded service contributes the
+same way: its ``state`` property re-assembles per-shard optimizer slices into
+the original unsharded structure before the Saver ever sees them.
 Writes can be made asynchronous (``async_write=True``): device→host snapshot
 happens synchronously, file IO on a background thread, double-buffered (a new
 save joins the previous write first).

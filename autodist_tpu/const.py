@@ -69,6 +69,8 @@ KNOWN_FLAGS = {
     "AUTODIST_WATCHDOG": "PS straggler/stall watchdog thread (0 disables)",
     "AUTODIST_WATCHDOG_SEC": "watchdog sample interval seconds (a worker "
                              "silent for 3x this is flagged stalled)",
+    "AUTODIST_ZERO": "ZeRO-style weight-update sharding: 0 off (default), "
+                     "1 on, N>1 on with N server-side PS apply shards",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -142,6 +144,12 @@ _ENV_DEFAULTS = {
     # thread per server, a handful of dict reads per interval.
     "AUTODIST_WATCHDOG": True,
     "AUTODIST_WATCHDOG_SEC": 10.0,
+    # ZeRO-style cross-replica weight-update sharding (arXiv 2004.13336):
+    # 0 = off (replicate the optimizer update, today's default), 1 = on
+    # (collective path shards opt state + update over the data-parallel axes;
+    # async-PS chiefs apply over the default shard count), N > 1 = on with N
+    # concurrent server-side PS apply shards. See DistributedRunner(zero=...).
+    "AUTODIST_ZERO": 0,
 }
 
 class ENV(enum.Enum):
@@ -171,6 +179,7 @@ class ENV(enum.Enum):
     AUTODIST_TRACE_PULL = "AUTODIST_TRACE_PULL"
     AUTODIST_WATCHDOG = "AUTODIST_WATCHDOG"
     AUTODIST_WATCHDOG_SEC = "AUTODIST_WATCHDOG_SEC"
+    AUTODIST_ZERO = "AUTODIST_ZERO"
 
     @property
     def val(self):

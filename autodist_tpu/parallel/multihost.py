@@ -43,6 +43,7 @@ def maybe_initialize_multihost(cluster=None) -> bool:
         logging.info("jax.distributed already initialized outside AutoDist; reusing")
         _initialized = True
         return True
+    _enable_cpu_collectives(jax)
     logging.info("jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
                  coordinator, num_processes, process_id)
     try:
@@ -61,6 +62,21 @@ def maybe_initialize_multihost(cluster=None) -> bool:
         raise
     _initialized = True
     return True
+
+
+def _enable_cpu_collectives(jax) -> None:
+    """Multiprocess SPMD on the CPU backend needs a cross-process collectives
+    implementation, and jax's default is ``none`` — every cross-process program
+    would fail with "Multiprocess computations aren't implemented on the CPU
+    backend". Select gloo (bundled with jaxlib) before the backend
+    initializes; a user's explicit choice (mpi, or an older jax without the
+    flag) is left alone."""
+    try:
+        if jax.config.read("jax_cpu_collectives_implementation") == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            logging.info("CPU backend: enabled gloo cross-process collectives")
+    except AttributeError:  # jax build without the flag: nothing to select
+        pass
 
 
 def _externally_initialized() -> bool:

@@ -20,6 +20,7 @@ import collections
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
@@ -68,10 +69,19 @@ class ParamPlan:
     # Batch-leaf name providing this sparse param's gather indices (model_spec jaxpr
     # provenance): enables the (indices, rows) wire format for gradient sync.
     index_leaf: Optional[str] = None
+    # Logical parameter shape (model_spec metadata): lets plan-level transforms
+    # (ZeRO opt-state sharding) reason about tiling without a live tree.
+    shape: Tuple[int, ...] = ()
 
 
 class ShardingPlan:
     """Per-parameter plans + mesh shape, derived from a compiled Strategy."""
+
+    # ZeRO-style weight-update sharding (arXiv 2004.13336) off by default;
+    # :meth:`with_zero_update` returns a plan with it on. An instance
+    # attribute on derived plans, a class default here so pre-existing
+    # pickles/constructions keep working.
+    zero = False
 
     def __init__(self, mesh_axes: "collections.OrderedDict[str, int]",
                  params: Dict[str, ParamPlan]):
@@ -89,7 +99,8 @@ class ShardingPlan:
         for name, pspec_meta in model_spec.params.items():
             if not pspec_meta.trainable:
                 plans[name] = ParamPlan(name=name, pspec=P(), opt_pspec=P(),
-                                        sync=SYNC_ALLREDUCE)
+                                        sync=SYNC_ALLREDUCE,
+                                        shape=tuple(pspec_meta.shape))
                 continue
             node = nodes.get(name)
             plans[name] = cls._plan_for(node, pspec_meta, mesh_axes)
@@ -110,7 +121,8 @@ class ShardingPlan:
             # No config for this param: replicate + implicit psum (safe default).
             return ParamPlan(name=meta.name, pspec=P(), opt_pspec=P(),
                              sync=SYNC_ALLREDUCE, sparse=meta.sparse,
-                             index_leaf=meta.index_leaf)
+                             index_leaf=meta.index_leaf,
+                             shape=tuple(meta.shape))
 
         partition_axis = None
         num_shards: Tuple[int, ...] = ()
@@ -159,7 +171,8 @@ class ShardingPlan:
                              partition_axis=partition_axis, num_shards=num_shards,
                              partition_mesh_axis=partition_mesh_axis,
                              padded_dim=padded_dim, logical_dim=logical_dim,
-                             index_leaf=meta.index_leaf)
+                             index_leaf=meta.index_leaf,
+                             shape=tuple(meta.shape))
 
         ar = sync_node.all_reduce_synchronizer
         return ParamPlan(name=meta.name, pspec=param_pspec, opt_pspec=param_pspec,
@@ -170,7 +183,8 @@ class ShardingPlan:
                          partition_axis=partition_axis, num_shards=num_shards,
                          partition_mesh_axis=partition_mesh_axis,
                          padded_dim=padded_dim, logical_dim=logical_dim,
-                         index_leaf=meta.index_leaf)
+                         index_leaf=meta.index_leaf,
+                         shape=tuple(meta.shape))
 
     # -------------------------------------------------------------- accessors
     @property
@@ -261,6 +275,55 @@ class ShardingPlan:
         """NamedSharding pytree for the parameter tree (by leaf path name)."""
         return _tree_shardings_by_name(mesh, params, {n: p.pspec for n, p in self.params.items()})
 
+    # ------------------------------------------- ZeRO weight-update sharding
+    def with_zero_update(self, mesh: Optional[Mesh] = None) -> "ShardingPlan":
+        """A copy of this plan with ZeRO-style weight-update sharding ON.
+
+        Every trainable parameter's ``opt_pspec`` shards the first axis that
+        tiles evenly over ALL data-parallel axes (not just the PS family's
+        ``reduce`` axis): optimizer-state memory drops to ``~size/dp`` per
+        device, and a jitted step whose grads/updates are constrained to these
+        specs lowers the update into reduce-scatter -> shard-local
+        ``optimizer.update`` -> all-gather (the arXiv 2004.13336 formulation,
+        inserted by XLA's SPMD partitioner under plain ``jit`` — no manual
+        collectives). Parameters whose shape has no evenly-tiling free axis
+        keep their existing (replicated / PS) opt sharding — the same
+        degeneration tiny variables already had.
+
+        ``mesh`` supplies the axis sizes the state will actually live on (the
+        runner may legally rebuild a smaller mesh than the strategy was built
+        for); defaults to the plan's own ``mesh_axes``."""
+        if mesh is not None:
+            axis_sizes = {a: mesh.shape.get(a, 1) for a in DP_AXES}
+        else:
+            axis_sizes = {a: self.mesh_axes.get(a, 1) for a in DP_AXES}
+        dp = int(np.prod(list(axis_sizes.values()))) if axis_sizes else 1
+        params = {}
+        for name, p in self.params.items():
+            pspec = _zero_update_pspec(p, dp)
+            params[name] = dataclasses.replace(p, opt_pspec=pspec) \
+                if pspec is not None else p
+        plan = ShardingPlan(self.mesh_axes, params)
+        plan.zero = True
+        return plan
+
+    def constrain_update(self, mesh: Mesh, tree: Any) -> Any:
+        """``lax.with_sharding_constraint`` a params-shaped tree (gradients or
+        optimizer updates) to the per-parameter ``opt_pspec``s — the
+        reduce-scatter insertion point of the ZeRO update. Traceable."""
+        return _constrain_tree(tree, _tree_shardings_by_name(
+            mesh, tree, {n: p.opt_pspec for n, p in self.params.items()}))
+
+    def constrain_opt(self, mesh: Mesh, opt_state: Any) -> Any:
+        """Constrain an optimizer-state tree to the plan's opt shardings
+        (shard-local moments stay sharded through the jitted step)."""
+        return _constrain_tree(opt_state, self.opt_sharding_tree(mesh, opt_state))
+
+    def constrain_params(self, mesh: Mesh, params: Any) -> Any:
+        """Constrain an updated parameter tree back to its storage shardings —
+        the all-gather closing the ZeRO update."""
+        return _constrain_tree(params, self.param_sharding_tree(mesh, params))
+
     def opt_sharding_tree(self, mesh: Mesh, opt_state: Any):
         """NamedSharding pytree for the optimizer state.
 
@@ -279,6 +342,50 @@ class ShardingPlan:
         return f"ShardingPlan(mesh={dict(self.mesh_axes)}, {dict(kinds)})"
 
 
+def _first_tiling_axis_pspec(shape, base_pspec: P, axis_token,
+                             divisor: int) -> Optional[P]:
+    """The single "shard the first free evenly-tiling axis" rule shared by
+    BOTH opt-state sharding derivations (PS-family ``reduce`` sharding and
+    ZeRO's full-dp sharding), so the two can never drift.
+
+    Puts ``axis_token`` on the first tensor axis that is not already taken by
+    a model/expert axis in ``base_pspec`` and whose dim divides ``divisor``
+    evenly; returns ``None`` when no axis tiles (callers pick their own
+    degeneration)."""
+    if divisor <= 1 or not shape:
+        return None
+    dims: list = list(base_pspec) if base_pspec \
+        and len(base_pspec) == len(shape) else [None] * len(shape)
+    for axis, dim in enumerate(shape):
+        if dims[axis] is None and dim > 0 and dim % divisor == 0:
+            dims[axis] = axis_token
+            return P(*dims)
+    return None
+
+
+def _zero_update_pspec(p: ParamPlan, dp: int) -> Optional[P]:
+    """The ZeRO opt-state PartitionSpec for one parameter, or ``None`` to keep
+    the plan's existing one.
+
+    The first free axis whose STORAGE dim (padded, for uneven partitioning)
+    tiles evenly over the TOTAL data-parallel size gets the whole ``DP_AXES``
+    tuple — every device is a data replica AND an update shard (meshes built
+    by :func:`~autodist_tpu.parallel.mesh.build_mesh` always carry both axes,
+    at size 1 when unused). Shapes with no evenly-tiling free axis return
+    ``None`` (keep replicated/PS sharding — the degeneration tiny variables
+    already had)."""
+    shape = list(p.shape)
+    if p.padded_dim is not None and p.partition_axis is not None:
+        shape[p.partition_axis] = p.padded_dim  # opt state embeds padded storage
+    return _first_tiling_axis_pspec(shape, p.pspec, DP_AXES, dp)
+
+
+def _constrain_tree(tree: Any, shardings: Any) -> Any:
+    import jax
+    return jax.tree_util.tree_map(
+        jax.lax.with_sharding_constraint, tree, shardings)
+
+
 def _zero_style_opt_pspec(meta, param_pspec: P, reduce_size: int) -> P:
     """Optimizer-state sharding for a PS parameter.
 
@@ -287,15 +394,9 @@ def _zero_style_opt_pspec(meta, param_pspec: P, reduce_size: int) -> P:
     nothing tiles (small/odd shapes) — those replicate, which is also what the
     reference's single-PS placement degenerates to for tiny vars.
     """
-    if reduce_size <= 1 or not meta.shape:
-        return param_pspec
-    dims: list = list(param_pspec) if param_pspec and len(param_pspec) == len(meta.shape) \
-        else [None] * len(meta.shape)
-    for axis, dim in enumerate(meta.shape):
-        if dims[axis] is None and dim > 0 and dim % reduce_size == 0:
-            dims[axis] = const.MESH_AXIS_REDUCE
-            return P(*dims)
-    return param_pspec
+    pspec = _first_tiling_axis_pspec(meta.shape, param_pspec,
+                                     const.MESH_AXIS_REDUCE, reduce_size)
+    return pspec if pspec is not None else param_pspec
 
 
 def _leaf_name(path) -> str:

@@ -658,11 +658,18 @@ class PSServer:
         if controller is not None:
             for wid, snap in controller.staleness_snapshot().items():
                 per_worker.setdefault(wid, {})["staleness"] = snap
-        return {"registry": telemetry.snapshot(),
+        snap = {"registry": telemetry.snapshot(),
                 "wire": self.wire.snapshot(),
                 "uptime_s": round(now - self._t_started, 3),
                 "anomalies": telemetry.events(),
                 "per_worker": per_worker}
+        # ZeRO-sharded PS apply: per-shard apply counters (the breakdown of
+        # the aggregate service version the staleness protocol rides on).
+        service = getattr(self._runner, "service", None)
+        shard_versions = getattr(service, "shard_versions", None)
+        if shard_versions is not None:
+            snap["shard_versions"] = list(shard_versions)
+        return snap
 
     def _store_worker_trace(self, worker_id, state):
         """The ``push_trace`` arm's sink: keep a worker's deposited span ring

@@ -25,11 +25,14 @@ dispatch loop** (SURVEY.md §7.3 hard part #1):
   consistent old version (state donation is disabled for exactly this reason).
 """
 
+import contextlib
 import math
 import threading
-from typing import Any, Dict, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
 
 import jax
+import numpy as np
 
 from autodist_tpu import telemetry
 from autodist_tpu.runner import DistributedRunner, TrainState
@@ -37,6 +40,35 @@ from autodist_tpu.telemetry.metrics import COUNT_BUCKETS, Histogram
 from autodist_tpu.utils import logging
 
 PyTree = Any
+
+# Default server-side apply shard count when ZeRO is requested without an
+# explicit count (AUTODIST_ZERO=1 / zero=True): enough fan-out to overlap
+# several workers' applies without flooding a small chief with threads.
+DEFAULT_PS_SHARDS = 4
+
+
+def _named_leaves(tree: PyTree) -> Dict[str, Any]:
+    """Flatten a pytree to ``{path-name: leaf}`` (the PS shard plane's
+    addressing — the same '/'-joined names the Saver uses)."""
+    from autodist_tpu.model_spec import _path_name
+    return {_path_name(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def _assign_shards(named: Dict[str, Any], shards: int) -> List[List[str]]:
+    """Partition leaf names into ``<= shards`` balanced groups (greedy
+    largest-first by byte size, deterministic: ties break by name)."""
+    shards = max(1, min(int(shards), len(named)) if named else 1)
+    sized = sorted(named.items(),
+                   key=lambda kv: (-int(getattr(kv[1], "nbytes", 0) or 0),
+                                   kv[0]))
+    bins: List[List[str]] = [[] for _ in range(shards)]
+    loads = [0] * shards
+    for name, leaf in sized:
+        s = loads.index(min(loads))
+        bins[s].append(name)
+        loads[s] += int(getattr(leaf, "nbytes", 0) or 0) or 1
+    return [sorted(b) for b in bins if b]
 
 
 class StalenessTimeout(TimeoutError):
@@ -391,6 +423,291 @@ class ParameterService:
                 self._lock.notify_all()
 
 
+class ShardedParameterService(ParameterService):
+    """ZeRO-style sharded PS apply: the chief applies each worker's update over
+    S concurrent parameter shards instead of one serial whole-tree program.
+
+    The parameter tree is statically partitioned into S balanced groups of
+    leaves; each shard owns its own mutex, its own optimizer-state slice
+    (``optimizer.init`` over the shard's flat ``{name: leaf}`` sub-dict — the
+    same per-leaf math as the whole-tree update for elementwise optimizer
+    chains), and its own version counter. ``apply(grads)`` fans the gradient
+    out to S tasks on a persistent pool: applies from DIFFERENT workers
+    interleave at shard granularity (worker B's shard-0 apply only waits for
+    worker A's shard-0, not A's whole tree) — the reference's multi-PS
+    placement (one PS device per partition, ``ps_lb_strategy``) re-expressed
+    as server-side concurrency.
+
+    Consistency contract: reads are SHARD-GRAINED. A ``read()`` overlapping an
+    apply may see some shards updated and others not — exactly the semantics
+    of a real multi-endpoint PS, where workers pull each shard independently.
+    The aggregate ``version`` bumps once per shard apply (S per full update),
+    so version equality still implies byte identity and the conditional-pull
+    /prefetch protocol (``read_if_newer``/``read_min``) is unchanged. The
+    ``state`` property re-assembles a whole-tree optimizer state (cached per
+    version) so checkpoints save UNSHARDED, restorable by any topology.
+
+    Whole-tree writers (``reset``/``adopt``) keep the base class's atomicity:
+    ``apply`` registers itself in an in-flight count under ``_write_mutex``,
+    and the writers quiesce that count before re-splitting — a restore can
+    never land between two shards of one worker's update.
+
+    NOTE: per-shard ``optimizer.update`` is exact for elementwise
+    transformations (sgd/momentum/adam-class — everything the async regime
+    supports); a cross-leaf coupling like ``clip_by_global_norm`` would see
+    per-shard norms. The async PS path already documents per-worker (unsynced)
+    updates, so cross-leaf coupling is out of contract there.
+    """
+
+    def __init__(self, state: TrainState, optimizer, shards: int, exec_fn):
+        """``exec_fn(fn, *args) -> out`` runs one jitted shard program to
+        completion (the runner supplies mesh scoping + execution
+        serialization); ``shards`` is clamped to the leaf count."""
+        super().__init__(state, apply_fn=None)
+        self._optimizer = optimizer
+        self._exec = exec_fn
+        self._params_flat = _named_leaves(state.params)
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        self._params_treedef = treedef
+        self._params_order = list(self._params_flat)  # flatten order == names order
+        self._assign = _assign_shards(self._params_flat, shards)
+        self.shards = len(self._assign)
+        self._shard_mutex = [threading.Lock() for _ in self._assign]
+        self._shard_version = [0] * self.shards
+        self._opt_template = state.opt_state
+        self._shard_opt = [
+            optimizer.init({n: self._params_flat[n] for n in names})
+            for names in self._assign]
+        self._step0 = int(np.asarray(jax.device_get(state.step)))
+        self._assembled: Optional[TrainState] = None
+        self._assembled_version = -1
+        # Version at which self._state's nested params tree was last rebuilt
+        # from the flat map (readers refresh lazily, cached per version).
+        self._state_version = self._version
+        # Whole-tree applies currently in flight (see class docstring).
+        self._inflight = 0
+        import optax as _optax
+        self._optax = _optax
+
+        def _apply_shard_fn(params_s, opt_s, grads_s):
+            updates, new_opt = optimizer.update(grads_s, opt_s, params_s)
+            return self._optax.apply_updates(params_s, updates), new_opt
+
+        self._shard_apply = jax.jit(_apply_shard_fn)
+        self._pool = ThreadPoolExecutor(max_workers=self.shards,
+                                        thread_name_prefix="ps-shard-apply")
+        logging.info("ShardedParameterService: %d apply shard(s) over %d "
+                     "leaves", self.shards, len(self._params_flat))
+
+    # ------------------------------------------------------------ shard plane
+    @property
+    def shard_versions(self) -> List[int]:
+        """Per-shard apply counters (the staleness/stats plane's breakdown of
+        the aggregate ``version``)."""
+        with self._lock:
+            return list(self._shard_version)
+
+    def _rebuild_params(self):
+        """Nested params tree from the flat map (callers hold ``_lock``)."""
+        return jax.tree_util.tree_unflatten(
+            self._params_treedef,
+            [self._params_flat[n] for n in self._params_order])
+
+    def _refresh_state_locked(self) -> TrainState:
+        """``self._state`` with its params tree current at ``self._version``,
+        rebuilding from the flat map at most once per version (callers hold
+        ``_lock``). Shard applies only touch the flat map, so the O(leaves)
+        unflatten is paid by the first reader after a change, not once per
+        shard inside the apply path."""
+        if self._state_version != self._version:
+            base = self._state
+            self._state = TrainState(
+                step=base.step, params=self._rebuild_params(),
+                opt_state=base.opt_state, ef_state=base.ef_state,
+                plan=base.plan)
+            self._state_version = self._version
+        return self._state
+
+    def _apply_one_shard(self, s: int, flat_grads: Dict[str, Any]):
+        names = self._assign[s]
+        grads_s = {n: flat_grads[n] for n in names}
+        with self._shard_mutex[s]:
+            with self._lock:
+                params_s = {n: self._params_flat[n] for n in names}
+                opt_s = self._shard_opt[s]
+            with telemetry.span("ps.apply", shard=s, shards=self.shards):
+                new_params_s, new_opt_s = self._exec(
+                    self._shard_apply, params_s, opt_s, grads_s)
+            with self._lock:
+                self._params_flat.update(new_params_s)
+                self._shard_opt[s] = new_opt_s
+                self._shard_version[s] += 1
+                self._version += 1
+                self._lock.notify_all()
+                if telemetry.enabled():
+                    telemetry.gauge(f"ps.shard_version.s{s}").set(
+                        self._shard_version[s])
+
+    def apply(self, grads: PyTree) -> int:
+        """Apply one worker's gradients across S concurrent shard programs;
+        returns the aggregate version after ALL shards landed (so the push
+        ack still means "my whole update is in", and finish_step ordering is
+        unchanged).
+
+        Registration under ``_write_mutex`` keeps whole-tree writers atomic:
+        a concurrent ``reset``/``adopt`` either runs before this update's
+        first shard or after its last, never in between — and different
+        workers' applies still interleave at shard granularity (the mutex is
+        held only for the counter bump, never across device work)."""
+        flat_grads = _named_leaves(grads)
+        with self._write_mutex:
+            with self._lock:
+                self._inflight += 1
+        try:
+            futures = [self._pool.submit(self._apply_one_shard, s, flat_grads)
+                       for s in range(self.shards)]
+            for f in futures:
+                f.result()  # re-raise a shard failure to the pushing worker
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._lock.notify_all()
+        with self._lock:
+            self._updates += 1
+            return self._version
+
+    # ----------------------------------------------------------- readers
+    # The base class's readers return self._state directly; here the nested
+    # params tree is rebuilt lazily from the flat map (cached per version),
+    # so each override refreshes before snapshotting. Same lock discipline,
+    # same return contracts.
+    def read(self):
+        with self._lock:
+            st = self._refresh_state_locked()
+            return st.params, st.ef_state, self._version
+
+    def read_if_newer(self, version: int):
+        with self._lock:
+            if self._version == version:
+                return None, None, self._version
+            st = self._refresh_state_locked()
+            return st.params, st.ef_state, self._version
+
+    def read_min(self, min_version: int, have_version: int,
+                 timeout: Optional[float] = None):
+        with self._lock:
+            self._lock.wait_for(lambda: self._version >= min_version, timeout)
+            if self._version == have_version:
+                return None, None, self._version
+            st = self._refresh_state_locked()
+            return st.params, st.ef_state, self._version
+
+    # -------------------------------------------------- whole-tree interface
+    @property
+    def state(self) -> TrainState:
+        """The assembled whole-tree state: params from the flat map, optimizer
+        state RE-ASSEMBLED into the original (unsharded) structure by leaf
+        name — checkpoints save exactly what an unsharded service would
+        (gather-on-save), so they restore into any topology. Cached per
+        version (the drop-in ``run()`` loop reads this every step)."""
+        with self._lock:
+            if self._assembled is not None \
+                    and self._assembled_version == self._version:
+                return self._assembled
+            base = self._refresh_state_locked()
+            shard_opt = list(self._shard_opt)
+            version = self._version
+            step = np.asarray(self._step0 + self._updates, np.int32)
+        from autodist_tpu.model_spec import _path_name
+        by_name: Dict[str, Any] = {}
+        for opt_s in shard_opt:
+            by_name.update(_named_leaves(opt_s))
+        merged_opt = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: by_name.get(_path_name(path), leaf),
+            self._opt_template)
+        assembled = TrainState(step=step, params=base.params,
+                               opt_state=merged_opt, ef_state=base.ef_state,
+                               plan=base.plan)
+        with self._lock:
+            if self._version == version:
+                self._assembled, self._assembled_version = assembled, version
+        return assembled
+
+    def _resplit_locked(self, state: TrainState, step0: int):
+        """Adopt a whole-tree state: re-seed the flat param map and split its
+        (unsharded) optimizer state back into per-shard slices by leaf name.
+        Callers hold ``_write_mutex`` + every shard mutex and pass the
+        already-read step counter (``step0``) so no device readback happens
+        inside the critical section (GL001)."""
+        from autodist_tpu.model_spec import _path_name
+        incoming_opt = _named_leaves(state.opt_state)
+        new_shard_opt = [
+            jax.tree_util.tree_map_with_path(
+                lambda path, leaf: incoming_opt.get(_path_name(path), leaf),
+                opt_s)
+            for opt_s in self._shard_opt]
+        with self._lock:
+            self._params_flat = _named_leaves(state.params)
+            self._shard_opt = new_shard_opt
+            self._opt_template = state.opt_state
+            self._state = state
+            self._step0 = step0
+            self._version += 1
+            self._state_version = self._version  # adopted tree IS current
+            self._updates = 0
+            self._assembled = None
+            self._lock.notify_all()
+
+    @contextlib.contextmanager
+    def _all_shard_mutexes(self):
+        # Ascending order everywhere; shard tasks only ever hold ONE, so the
+        # whole-tree writers (reset/adopt) cannot deadlock against them.
+        with contextlib.ExitStack() as stack:
+            for m in self._shard_mutex:
+                stack.enter_context(m)
+            yield
+
+    def _quiesce_locked(self):
+        """Wait (bounded) for in-flight whole-tree applies to finish. Callers
+        hold ``_write_mutex`` — new applies cannot register — so the count
+        only falls. A shard program that wedges for 10 minutes is already a
+        dead chief; raising names the writer instead of deadlocking it."""
+        with self._lock:
+            if not self._lock.wait_for(lambda: self._inflight == 0,
+                                       timeout=600.0):
+                raise RuntimeError(
+                    "sharded PS apply did not quiesce within 600s; cannot "
+                    "safely reset/adopt a whole-tree state")
+
+    def reset(self, state: TrainState):
+        step0 = int(np.asarray(jax.device_get(state.step)))  # before any lock
+        with self._write_mutex:
+            self._quiesce_locked()
+            with self._all_shard_mutexes():
+                self._resplit_locked(state, step0)
+
+    def adopt(self, state: TrainState, place_fn) -> None:
+        step0 = int(np.asarray(jax.device_get(state.step)))  # before any lock
+        with self._write_mutex:
+            self._quiesce_locked()
+            if state is self._state or state is self._assembled:
+                return
+            if self._updates != 0:
+                raise RuntimeError(
+                    "AsyncPSRunner.run was handed a state that is not the "
+                    "service's current state after updates were already "
+                    "applied; use restore(state) to adopt a checkpoint "
+                    "explicitly")
+            placed = place_fn(state)
+            with self._all_shard_mutexes():
+                self._resplit_locked(placed, step0)
+
+    def close(self):
+        """Release the shard-apply pool (idle threads otherwise linger for
+        the process's life)."""
+        self._pool.shutdown(wait=False)
+
+
 class AsyncWorker:
     """One logical worker's handle (reference: one re-executed user script per node)."""
 
@@ -450,10 +767,17 @@ class AsyncPSRunner(DistributedRunner):
     def __init__(self, compiled_strategy, model_spec, loss_fn, optimizer,
                  mesh=None, has_aux: bool = False, num_workers: int = 1,
                  donate_state: bool = False, plan=None,
-                 ps_address: Optional[str] = None):
+                 ps_address: Optional[str] = None,
+                 zero: Optional[Any] = None):
         # Never donate: stale workers hold references to old param buffers.
         super().__init__(compiled_strategy, model_spec, loss_fn, optimizer,
-                         mesh=mesh, has_aux=has_aux, donate_state=False, plan=plan)
+                         mesh=mesh, has_aux=has_aux, donate_state=False,
+                         plan=plan, zero=zero)
+        # The async regime's ZeRO knob is the SERVER-SIDE apply shard count
+        # (the opt state lives on the chief, not spread over an SPMD mesh):
+        # zero=N>1 picks N shards, zero=1/True the default fan-out, 0 off.
+        self.ps_shards = self.zero if self.zero > 1 \
+            else (DEFAULT_PS_SHARDS if self.zero else 1)
         if self.plan.has_compression:
             raise NotImplementedError(
                 "Gradient compression is not supported in the async PS mode")
@@ -511,10 +835,17 @@ class AsyncPSRunner(DistributedRunner):
             # The chief owns the authoritative state; this process only computes
             # gradients (its local state is a template for shapes/compile).
             return state
-        apply_fn = jax.jit(
-            self._apply, in_shardings=(self._state_shardings, None),
-            out_shardings=self._state_shardings)
-        self.service = ParameterService(state, self._locked_apply(apply_fn))
+        if self.ps_shards > 1:
+            # ZeRO PS path: S concurrent shard applies (shard-local opt
+            # state, per-shard version counters) instead of one serial
+            # whole-tree program.
+            self.service = ShardedParameterService(
+                state, self._optimizer, self.ps_shards, self._shard_exec)
+        else:
+            apply_fn = jax.jit(
+                self._apply, in_shardings=(self._state_shardings, None),
+                out_shardings=self._state_shardings)
+            self.service = ParameterService(state, self._locked_apply(apply_fn))
         if self._ps_address:
             from autodist_tpu.parallel.ps_transport import PSServer
             host, _, port = self._ps_address.rpartition(":")
@@ -562,6 +893,21 @@ class AsyncPSRunner(DistributedRunner):
                     return new_state
         return run
 
+    def _shard_exec(self, fn, *args):
+        """Run one sharded-PS apply program to completion (the
+        :class:`ShardedParameterService`'s ``exec_fn``): mesh-scoped, and
+        execution-serialized for the same reason as :meth:`_locked_apply` —
+        shard programs time-share this process's device pool with worker
+        gradient programs, and concurrent multi-device executions must not
+        interleave (the fan-out still overlaps host-side split/merge work and
+        keeps per-shard mutexes independent across workers)."""
+        with self.mesh:
+            # graftlint: disable=GL001(execution-serialization lock by design — same contract as _locked_apply, per apply shard)
+            with self._collective_lock:
+                out = fn(*args)
+                jax.block_until_ready(out)
+                return out
+
     def wire_stats(self):
         """Transport wire counters for the async-PS log line — the worker's
         client-side accounting, or the chief's server-side aggregate; ``None``
@@ -605,6 +951,8 @@ class AsyncPSRunner(DistributedRunner):
         if self._remote_worker is not None:
             self._remote_worker.close()
             self._remote_worker = None
+        if isinstance(self.service, ShardedParameterService):
+            self.service.close()
 
     # ------------------------------------------------------------------ workers
     def worker(self, worker_id: int) -> AsyncWorker:

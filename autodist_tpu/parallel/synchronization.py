@@ -21,6 +21,13 @@ Reference counterparts:
 - PS synchronizers need no explicit code here: weight-update sharding is expressed
   entirely through the plan's opt-state shardings (XLA emits the reduce-scatter /
   all-gather), replacing accumulators and token queues (``ps_synchronizer.py``).
+- ZeRO weight-update sharding (``ShardingPlan.with_zero_update``, arXiv
+  2004.13336) composes with everything here without code changes: the grad fn's
+  outputs stay replicated-spec'd and the runner's step body reshards them at
+  the constraint points, while the error-feedback residuals below were ALREADY
+  ZeRO-form — a ``[dp, ...]`` leading dim sharded over the data axes, so each
+  device owns exactly its 1/dp residual slice (``init_ef_state``/
+  ``ef_partition_specs`` are the same treatment applied to compressor state).
 """
 
 import dataclasses
@@ -206,6 +213,12 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
             aux = ()
         return grads, loss, aux, ef_state
 
+    # Which lowering a grad fn took, as an attribute: callers (and the test
+    # suite's `requires_shard_map` guard — the explicit path is the one thing
+    # here that needs `jax.shard_map`, absent from some jax builds) can ask
+    # without re-deriving the decision.
+    implicit.uses_shard_map = False
+
     if not use_explicit:
         return implicit
 
@@ -345,6 +358,7 @@ def make_grad_fn(sharding_plan: ShardingPlan, model_spec: ModelSpec, mesh: Mesh,
         )(params, batch, ef_state)
         return out
 
+    explicit.uses_shard_map = True
     return explicit
 
 
@@ -406,7 +420,11 @@ def init_ef_state(sharding_plan: ShardingPlan, params: PyTree,
     POWER_SGD parameters, and 0-d zeros elsewhere (so the tree rides the same
     sharding derivation). Residuals carry a leading ``dp`` dimension — one slice per
     data-parallel replica (the reference kept the residual as per-worker Python
-    state inside the compressor object, ``compressor.py:120-143``).
+    state inside the compressor object, ``compressor.py:120-143``). This IS the
+    ZeRO sharding treatment for compressor state: residual memory is already
+    ``size/dp`` per device whether or not the plan enables
+    ``with_zero_update`` for the optimizer state (PowerSGD's ``q`` must stay
+    replicated — every replica contracts against the full factor each step).
 
     With ``mesh``, the residuals are allocated directly with their sharding (a
     ``[dp, ...]`` residual materialized replicated first would cost dp× parameter

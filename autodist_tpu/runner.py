@@ -160,9 +160,21 @@ class DistributedRunner:
     def __init__(self, compiled_strategy, model_spec: ModelSpec, loss_fn: Callable,
                  optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
                  donate_state: bool = True, plan: Optional[ShardingPlan] = None,
-                 accumulation_steps: int = 1, batch_size: Optional[int] = None):
+                 accumulation_steps: int = 1, batch_size: Optional[int] = None,
+                 zero: Optional[Any] = None):
         if accumulation_steps < 1:
             raise ValueError("accumulation_steps must be >= 1")
+        # ZeRO-style weight-update sharding (arXiv 2004.13336; ``zero=None``
+        # reads AUTODIST_ZERO): 0/False off, 1/True on, N>1 on with N
+        # server-side PS apply shards (the async regime's knob). On the
+        # synchronous path "on" reshards the plan's opt-state specs over the
+        # data-parallel axes and constrains grads/updates/params in the step
+        # body, so XLA lowers the update into reduce-scatter -> shard-local
+        # optimizer.update -> all-gather.
+        if zero is None:
+            from autodist_tpu import const
+            zero = const.ENV.AUTODIST_ZERO.val
+        self.zero = int(zero)
         # Explicit global batch size for micro-batch splitting; when None it is
         # inferred per batch as the modal leading dim (see shard_batch).
         self._batch_size = batch_size
@@ -175,6 +187,11 @@ class DistributedRunner:
         self.plan = plan if plan is not None \
             else ShardingPlan.from_strategy(compiled_strategy, model_spec)
         self.mesh = mesh if mesh is not None else self._mesh_from_plan()
+        if self.zero and not self.plan.is_async and not self.plan.zero:
+            # Synchronous regimes take the SPMD lowering; the async/PS regime
+            # keeps its plan and shards the server-side apply instead
+            # (parallel/staleness.py) — its opt state lives on the chief only.
+            self.plan = self.plan.with_zero_update(self.mesh)
         # Uneven partitioning: state leaves live padded (XLA needs even tiles); the
         # user's loss fn sees logical shapes. Differentiating through the unpad
         # slice zero-fills the pad region of the gradient, so padded rows never
@@ -255,6 +272,10 @@ class DistributedRunner:
         optimizer = self._optimizer
         grad_fn = self._grad_fn
         accum = self._accum
+        # ZeRO update sharding: constraint points for the jitted step. Captured
+        # as (plan, mesh) statics so the body stays a pure function of state.
+        zero_plan = self.plan if self.plan.zero else None
+        mesh = self.mesh
 
         def accumulate(params, batch, ef_state):
             """Gradient accumulation: scan grad_fn over the micro axis, summing
@@ -299,8 +320,21 @@ class DistributedRunner:
             else:
                 grads, loss, aux, ef_state = grad_fn(state.params, batch,
                                                      state.ef_state)
+            if zero_plan is not None:
+                # ZeRO weight-update sharding (arXiv 2004.13336): constraining
+                # the gradient to the opt-state shards makes XLA materialize it
+                # as a reduce-scatter; the optimizer update then runs on 1/dp
+                # of each parameter per device.
+                grads = zero_plan.constrain_update(mesh, grads)
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            if zero_plan is not None:
+                updates = zero_plan.constrain_update(mesh, updates)
+                opt_state = zero_plan.constrain_opt(mesh, opt_state)
             params = optax.apply_updates(state.params, updates)
+            if zero_plan is not None:
+                # Back to the storage sharding — the all-gather closing the
+                # sharded update.
+                params = zero_plan.constrain_params(mesh, params)
             new_state = TrainState(step=state.step + 1, params=params,
                                    opt_state=opt_state, ef_state=ef_state,
                                    plan=state.plan)

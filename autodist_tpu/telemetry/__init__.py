@@ -32,6 +32,7 @@ from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
                                             merge_trace_states, ntp_offset)
 from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
                                            export_chrome_trace,
+                                           opt_state_bytes,
                                            sample_device_memory)
 from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                             Registry, counter, event, events,
@@ -47,7 +48,7 @@ __all__ = [
     "counter", "gauge", "histogram", "registry", "snapshot",
     "event", "events",
     "export_chrome_trace", "chrome_trace_events", "emit_metrics",
-    "sample_device_memory",
+    "sample_device_memory", "opt_state_bytes",
     "collect_cluster_trace", "local_trace_state", "merge_trace_states",
     "dump_spans_jsonl", "load_trace_jsonl", "ntp_offset",
 ]

@@ -20,7 +20,32 @@ from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.telemetry import spans as _spans
 from autodist_tpu.utils import logging
 
-__all__ = ["export_chrome_trace", "emit_metrics", "sample_device_memory"]
+__all__ = ["export_chrome_trace", "emit_metrics", "sample_device_memory",
+           "opt_state_bytes"]
+
+
+def opt_state_bytes(opt_state) -> int:
+    """Per-device resident bytes of an optimizer-state tree: the max over
+    local devices of the shard bytes each holds. A replicated leaf counts its
+    full size on every device; a ZeRO-sharded leaf counts ``1/dp`` — so this
+    is exactly the number weight-update sharding divides (`bench.py --zero`
+    gates the ratio, and ``train()`` samples it as the
+    ``train.opt_state_bytes`` gauge at log boundaries). Host (numpy) leaves
+    count once, as chief-resident."""
+    import jax
+    per_dev: dict = {}
+    host = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        if isinstance(leaf, jax.Array):
+            try:
+                for sh in leaf.addressable_shards:
+                    dev = sh.device.id
+                    per_dev[dev] = per_dev.get(dev, 0) + int(sh.data.nbytes)
+                continue
+            except (RuntimeError, ValueError, TypeError, AttributeError):
+                pass  # deleted/donated or exotic backend: fall through
+        host += int(getattr(leaf, "nbytes", 0) or 0)
+    return (max(per_dev.values()) if per_dev else 0) + host
 
 
 def chrome_trace_events(since_ns=None, pid: Optional[int] = None,
@@ -78,7 +103,7 @@ def export_chrome_trace(path: str, since_ns=None, pid: Optional[int] = None,
     return path
 
 
-def sample_device_memory() -> int:
+def sample_device_memory(opt_state=None) -> int:
     """Sample live-buffer and device-memory gauges into the registry; returns
     the number of gauges written.
 
@@ -87,11 +112,21 @@ def sample_device_memory() -> int:
     growth across log boundaries) and, where the backend reports allocator
     stats (TPU/GPU; CPU returns none), per-device
     ``device.mem.bytes_in_use.d<id>`` / ``device.mem.bytes_limit.d<id>``.
+    With ``opt_state``, additionally writes ``train.opt_state_bytes`` — the
+    per-device optimizer-state footprint (:func:`opt_state_bytes`), the gauge
+    ZeRO weight-update sharding divides by the data-parallel size.
     Called by ``train()`` at log boundaries when telemetry is enabled; a
     diagnostics sampler must never break training, so backend hiccups are
     swallowed at debug level."""
     import jax
     wrote = 0
+    if opt_state is not None:
+        try:
+            _metrics.gauge("train.opt_state_bytes").set(
+                opt_state_bytes(opt_state))
+            wrote += 1
+        except (RuntimeError, ValueError, TypeError, AttributeError) as e:
+            logging.debug("opt-state byte sampling unavailable: %s", e)
     try:
         live = jax.live_arrays()
         _metrics.gauge("device.live_buffers").set(len(live))

@@ -189,8 +189,9 @@ def train(runner, params: PyTree,
                              f" | {stats.format_line()}" if stats else "")
                 if telemetry.enabled():
                     # Memory gauges first so the snapshot emitted below
-                    # carries this boundary's live-buffer/HBM readings.
-                    telemetry.sample_device_memory()
+                    # carries this boundary's live-buffer/HBM readings (and
+                    # the opt-state footprint ZeRO sharding divides).
+                    telemetry.sample_device_memory(opt_state=state.opt_state)
                     telemetry.emit_metrics(global_step=step_i + 1)
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
@@ -303,8 +304,9 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                              meter.last_readback_s)
                 if telemetry.enabled():
                     # Memory gauges first so the emitted snapshot carries
-                    # this boundary's live-buffer/HBM readings.
-                    telemetry.sample_device_memory()
+                    # this boundary's live-buffer/HBM readings (and the
+                    # opt-state footprint ZeRO sharding divides).
+                    telemetry.sample_device_memory(opt_state=state.opt_state)
                     telemetry.emit_metrics(global_step=step_i)
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)

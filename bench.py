@@ -380,6 +380,129 @@ def trace_pull_overhead(rounds: int = 5):
     return result
 
 
+def zero_update_bench(steps: int = 60, dp: int = 2):
+    """ZeRO weight-update sharding (arXiv 2004.13336) memory/step bench.
+
+    Runs the CPU micro-model (same shape class as the other micro-benches)
+    twice on a dp-device mesh — ``zero=0`` (replicated optimizer update,
+    today's default) and ``zero=1`` (reduce-scatter -> shard-local update ->
+    all-gather) — and reports:
+
+    - ``opt_bytes``: per-device resident optimizer-state bytes
+      (``telemetry.opt_state_bytes`` — max over devices of the shard bytes
+      each holds), unsharded vs sharded. The GATED number is their ratio:
+      the recorded ``zero_update`` row carries ``min_opt_bytes_ratio``
+      (1.5 at dp=2; the ideal is ~dp, less the replicated scalar leaves),
+      and falling below it means the plan stopped sharding the moments.
+    - ``steps_s``: steps/s for both runs (informational — on CPU the
+      collectives the constraint points insert are host work, so sharded is
+      expected to cost a few percent; on real pods the reduce-scatter is
+      cheaper than the all-reduce it replaces).
+    - ``live_bytes``: per-device resident bytes over ALL live arrays after
+      each run (max over devices of the shard bytes each holds — the same
+      accounting as the PR 5 ``device.live_bytes`` gauge family).
+      Informational only: on the CPU backend the dispatch device also holds
+      tracing/executable residue (a params-sized constant copy survives
+      session construction), which blurs whole-process accounting — the
+      clean, gated signal is ``opt_bytes``.
+
+    Needs >= dp local devices: when the host exposes fewer (plain
+    ``python bench.py --zero`` on a 1-CPU box), dp CPU devices are simulated
+    via XLA_FLAGS before the backend initializes — which is why this must
+    run before any other jax-touching bench in the same process."""
+    import os
+    import sys
+
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={dp}").strip()
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    if n_dev < dp:
+        print(json.dumps({"metric": "zero_update", "skipped":
+                          f"needs >= {dp} devices, found {n_dev} (jax was "
+                          f"already initialized before --zero could simulate "
+                          f"them)"}))
+        return None
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+    # Source params live on the host: a device-0 jnp copy would sit in
+    # jax.live_arrays() across both runs and dominate the per-device max.
+    params = jax.tree_util.tree_map(np.asarray, params)
+
+    def measure(zero):
+        ad = AutoDist(strategy_builder=AllReduce())
+        runner = ad.create_distributed_session(
+            loss_fn, params, optax.adam(1e-3), example_batch=batch, zero=zero)
+        state = runner.init(params)
+        opt_bytes = telemetry.opt_state_bytes(state.opt_state)
+        loss = None
+        for _ in range(5):          # compile + warmup
+            state, loss = runner.run(state, batch)
+        _ = jax.device_get(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = runner.run(state, batch)
+        _ = jax.device_get(loss)
+        rate = steps / (time.perf_counter() - t0)
+        # Per-device resident bytes (a sharded array's global .nbytes would
+        # count every shard on every device and hide the saving). Collect
+        # first: the previous run's donated-buffer cycles otherwise linger
+        # in jax.live_arrays() and mask the difference.
+        import gc
+        gc.collect()
+        live = telemetry.opt_state_bytes(jax.live_arrays())
+        del state
+        return opt_bytes, rate, live
+
+    bytes_plain, rate_plain, live_plain = measure(0)
+    bytes_zero, rate_zero, live_zero = measure(1)
+    ratio = bytes_plain / max(1, bytes_zero)
+
+    result = {
+        "metric": f"zero_update ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size}, adam)",
+        "unit": "bytes/device",
+        "rows": {"opt_bytes_unsharded": bytes_plain,
+                 "opt_bytes_sharded": bytes_zero},
+        "opt_bytes_ratio": round(ratio, 3),
+        "steps_s": {"unsharded": round(rate_plain, 2),
+                    "sharded": round(rate_zero, 2)},
+        "live_bytes": {"unsharded": live_plain, "sharded": live_zero},
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("zero_update")
+        if recorded:
+            floor = recorded.get("min_opt_bytes_ratio", 1.5)
+            if ratio < floor:
+                print(f"WARNING: ZeRO opt-state per-device bytes ratio "
+                      f"{ratio:.2f}x is below the {floor:.2f}x gate at "
+                      f"dp={n_dev} — weight-update sharding stopped dividing "
+                      f"the optimizer state (see PERF_BASELINE.json "
+                      f"zero_update)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    return result
+
+
 def unroll_sweep(factors):
     """Measure the fused multi-step path (``runner.run_many``) at each unroll
     factor and print ONE JSON line with the steps/s curve.
@@ -507,6 +630,14 @@ def main(argv=None):
              "and the loopback round-trip of one `trace` opcode pull, gated "
              "against max_stall_ms in the PERF_BASELINE.json trace_pull row")
     parser.add_argument(
+        "--zero", action="store_true",
+        help="measure ZeRO weight-update sharding (AUTODIST_ZERO / zero=1) "
+             "on the CPU micro-model at simulated dp>=2: per-device "
+             "optimizer-state bytes and steps/s, unsharded vs sharded, "
+             "gated against min_opt_bytes_ratio in the PERF_BASELINE.json "
+             "zero_update row (must run first in a fresh process so the "
+             "simulated devices can be created)")
+    parser.add_argument(
         "--profile", type=int, default=0, metavar="N",
         help="dump a jax.profiler trace (Perfetto/TensorBoard format) of an "
              "N-step window after warmup; the trace directory is reported in "
@@ -520,6 +651,9 @@ def main(argv=None):
         return
     if args.trace_pull_overhead:
         trace_pull_overhead()
+        return
+    if args.zero:
+        zero_update_bench()
         return
     if args.unroll:
         try:

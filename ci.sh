@@ -89,7 +89,11 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "=== [4/4] bench smoke ==="
-# Wire micro-bench first: CPU-safe, sub-minute, and it gates the zero-copy
+# ZeRO weight-update sharding gate FIRST: it must run in a fresh process so
+# it can simulate a dp=2 CPU mesh before the backend initializes; gates the
+# per-device opt-state byte ratio against the zero_update row.
+python bench.py --zero
+# Wire micro-bench: CPU-safe, sub-minute, and it gates the zero-copy
 # PS codec path against the recorded ps_wire row on every CI pass.
 python bench.py --wire
 # Telemetry cost gate: disabled-mode span overhead must stay within

@@ -1,0 +1,50 @@
+"""Shared skip-guard for tests that need ``jax.shard_map``.
+
+Some jax builds (including this box's — see ROADMAP "Known environment
+caveats") ship no ``jax.shard_map``; every package path written against it
+(the explicit compressed/sparse-wire gradient lowering, sequence/context
+parallelism, ring/Ulysses attention, the pipeline-parallel loops) raises
+``AttributeError`` at trace time there. Those are ENVIRONMENT limitations,
+not regressions: this helper turns them into skips so tier-1 reports signal,
+not ~100 known-env failures.
+
+Usage::
+
+    from shardmap_compat import requires_shard_map, skip_unless_shard_map
+
+    pytestmark = requires_shard_map            # whole module needs it
+    @requires_shard_map                        # ...or one test
+    def test_ring_attention(): ...
+
+    def test_matrix(builder, case):            # data-dependent lowering:
+        step = ad.function(...)
+        skip_unless_shard_map(step.runner)     # skips iff THIS plan compiled
+                                               # to the shard_map lowering
+"""
+
+import jax
+import pytest
+
+HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+SKIP_REASON = ("this jax build has no jax.shard_map (known environment "
+               "caveat, see ROADMAP.md); the path under test cannot lower")
+
+requires_shard_map = pytest.mark.skipif(not HAS_SHARD_MAP,
+                                        reason=SKIP_REASON)
+
+
+def skip_unless_shard_map(runner) -> None:
+    """Skip the calling test when ``runner``'s gradient function compiled to
+    the explicit (``jax.shard_map``) lowering on a build without it.
+
+    Parametrized matrices (strategy x case x mesh) take the explicit path only
+    for some combinations (a compressor, a sparse-wire embedding, an honored
+    DCN hint — ``make_grad_fn`` tags the decision as ``uses_shard_map``), so a
+    blanket file marker would skip healthy combos; this guard skips exactly
+    the ones that cannot run."""
+    if HAS_SHARD_MAP:
+        return
+    grad_fn = getattr(runner, "_grad_fn", None)
+    if getattr(grad_fn, "uses_shard_map", False):
+        pytest.skip(SKIP_REASON)

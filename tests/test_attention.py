@@ -13,6 +13,7 @@ from autodist_tpu.ops.blockwise_attention import blockwise_attention
 from autodist_tpu.ops.flash_attention import flash_attention
 from autodist_tpu.parallel.mesh import build_mesh
 from autodist_tpu.parallel.ring_attention import ring_attention
+from shardmap_compat import requires_shard_map
 
 B, L, H, D = 2, 64, 4, 16
 
@@ -106,6 +107,7 @@ def test_flash_gradients_flow():
         _close(a, b, atol=3e-4, mxu=0.05)
 
 
+@requires_shard_map
 @_NEEDS_MESH
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_single_device(causal):
@@ -123,6 +125,7 @@ def test_ring_attention_matches_single_device(causal):
 
 
 @_NEEDS_MESH
+@requires_shard_map
 def test_ring_attention_gradients_flow():
     mesh = build_mesh(axes={const.MESH_AXIS_SEQ: 4, const.MESH_AXIS_DATA: 2})
     q, k, v = _qkv(6)
@@ -206,6 +209,7 @@ def test_flash_carry_matches_blockwise_carry():
         _close(a, b_, atol=1e-5, rtol=1e-5, mxu=0.05, err_msg=name)
 
 
+@requires_shard_map
 @_NEEDS_MESH
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_flash_matches_ring_blockwise(causal):

@@ -12,6 +12,7 @@ import pytest
 from autodist_tpu import AutoDist
 from autodist_tpu.checkpoint import SavedModelBuilder, Saver
 from autodist_tpu.strategy import AllReduce, PartitionedPS, PS
+from shardmap_compat import requires_shard_map
 
 
 def _loss(p, batch):
@@ -237,6 +238,7 @@ def test_saved_model_polymorphic_batch(tmp_path):
                                    rtol=1e-6, atol=1e-6)
 
 
+@requires_shard_map
 def test_ef_restore_across_dp_topologies(tmp_path):
     """Checkpoints with per-replica compressor residuals restore onto a different
     data-parallel size: shape-stable leaves (PowerSGD Q) restore, dp-sized residuals

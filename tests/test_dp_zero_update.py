@@ -1,0 +1,343 @@
+"""ZeRO-style cross-replica weight-update sharding (arXiv 2004.13336).
+
+Covers both regimes behind the ``AUTODIST_ZERO`` / ``zero=`` knob:
+
+- collective path: ``ShardingPlan.with_zero_update`` reshards the optimizer
+  state over the data-parallel axes and the jitted step constrains
+  grads/updates/params, so XLA lowers the update into reduce-scatter ->
+  shard-local update -> all-gather. Pinned here: parity with the unsharded
+  update over sgd/momentum/adam, composition with ``unroll=K`` and gradient
+  accumulation, and the per-device optimizer-state byte reduction.
+- async-PS path: ``ShardedParameterService`` applies each worker's update
+  over S concurrent parameter shards on the chief. Pinned here: parity with
+  the serial service, per-shard version accounting under the staleness gate,
+  the ``ps.apply`` span fan-out, and gather-on-save checkpoints restoring
+  across sharded/unsharded topologies.
+
+Named ``test_dp_zero_update`` (not ``test_zero_update``) so it sorts
+IN-WINDOW — before ``test_image_data`` — per the tier-1 budget convention
+(see test_host_telemetry / test_cluster_trace); pure in-process, no
+subprocess.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist, telemetry
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.parallel.plan import ParamPlan, ShardingPlan
+from autodist_tpu.parallel.staleness import (AsyncPSRunner,
+                                             ShardedParameterService,
+                                             StalenessTimeout)
+from autodist_tpu.strategy import AllReduce, PS
+
+BATCH = 32
+D_IN, D_HID, D_OUT = 8, 16, 16
+
+
+def _loss(p, b):
+    h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+    return jnp.mean((b["y"] - h @ p["w2"]) ** 2)
+
+
+def _params():
+    rng = np.random.RandomState(7)
+    return {"w1": rng.randn(D_IN, D_HID).astype(np.float32) * 0.3,
+            "b1": np.zeros((D_HID,), np.float32),
+            "w2": rng.randn(D_HID, D_OUT).astype(np.float32) * 0.3}
+
+
+def _batch(i):
+    rng = np.random.RandomState(100 + i)
+    return {"x": rng.randn(BATCH, D_IN).astype(np.float32),
+            "y": rng.randn(BATCH, D_OUT).astype(np.float32)}
+
+
+def _session(optimizer, zero, **kw):
+    ad = AutoDist(strategy_builder=AllReduce())
+    return ad.create_distributed_session(_loss, _params(), optimizer,
+                                         example_batch=_batch(0), zero=zero,
+                                         **kw)
+
+
+def _run_steps(runner, n, start=0):
+    state = runner.init(_params())
+    for i in range(start, start + n):
+        state, loss = runner.run(state, _batch(i))
+    return state, loss
+
+
+def _assert_tree_close(a, b, **tol):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(jax.device_get(x)),
+                                   np.asarray(jax.device_get(y)), **tol)
+
+
+# --------------------------------------------------------- collective path
+
+OPTIMIZERS = {
+    "sgd": lambda: optax.sgd(0.05),
+    "momentum": lambda: optax.sgd(0.05, momentum=0.9),
+    "adam": lambda: optax.adam(1e-2),
+}
+
+
+@pytest.mark.parametrize("opt_name", list(OPTIMIZERS), ids=str)
+def test_sharded_update_parity(opt_name):
+    """zero=1 must train to the same params AND the same (gathered) optimizer
+    state as the replicated update, for every optimizer family the repo
+    benches (elementwise transformation chains)."""
+    s0, _ = _run_steps(_session(OPTIMIZERS[opt_name](), zero=0), 5)
+    s1, _ = _run_steps(_session(OPTIMIZERS[opt_name](), zero=1), 5)
+    _assert_tree_close(s0.params, s1.params, rtol=1e-5, atol=1e-6)
+    _assert_tree_close(s0.opt_state, s1.opt_state, rtol=1e-5, atol=1e-6)
+
+
+def test_opt_state_sharded_and_bytes_divided():
+    """The moments are PHYSICALLY sharded over the dp axes and the per-device
+    footprint drops by ~dp (every leaf of this model tiles evenly)."""
+    r0 = _session(optax.adam(1e-2), zero=0)
+    r1 = _session(optax.adam(1e-2), zero=1)
+    assert r1.plan.zero and not r0.plan.zero
+    st0, st1 = r0.init(_params()), r1.init(_params())
+    dp = r1.plan.dp_size
+    assert dp >= 2
+    specs = {str(l.sharding.spec)
+             for l in jax.tree_util.tree_leaves(st1.opt_state)
+             if hasattr(l, "sharding") and l.ndim}
+    assert any("data" in s for s in specs), specs
+    b0 = telemetry.opt_state_bytes(st0.opt_state)
+    b1 = telemetry.opt_state_bytes(st1.opt_state)
+    # Every moment leaf tiles evenly here, so the ratio is ~dp exactly (the
+    # scalar step counter stays replicated); 1.5 is the bench gate floor.
+    assert b0 / b1 >= max(1.5, dp / 2), (b0, b1, dp)
+
+
+def test_unroll_composition():
+    """run_many (fused K-step scan) under zero=1: same step body, so the
+    fused path must match K sequential run() calls exactly, and the
+    replicated reference within float tolerance."""
+    runner = _session(optax.adam(1e-2), zero=1)
+    state_a = runner.init(_params())
+    for i in range(4):
+        state_a, _ = runner.run(state_a, _batch(i))
+    state_b = runner.init(_params())
+    state_b, losses = runner.run_many(state_b, [_batch(i) for i in range(4)])
+    assert losses.shape == (4,)
+    _assert_tree_close(state_a.params, state_b.params, rtol=0, atol=0)
+    s_ref, _ = _run_steps(_session(optax.adam(1e-2), zero=0), 4)
+    _assert_tree_close(s_ref.params, state_b.params, rtol=1e-5, atol=1e-6)
+
+
+def test_accumulation_composition():
+    """Gradient accumulation's micro-batch scan composes with the sharded
+    update: zero=1 parity vs zero=0 at accumulation_steps=2."""
+    s0, _ = _run_steps(_session(optax.adam(1e-2), zero=0,
+                                accumulation_steps=2), 4)
+    s1, _ = _run_steps(_session(optax.adam(1e-2), zero=1,
+                                accumulation_steps=2), 4)
+    _assert_tree_close(s0.params, s1.params, rtol=1e-5, atol=1e-6)
+    _assert_tree_close(s0.opt_state, s1.opt_state, rtol=1e-5, atol=1e-6)
+
+
+def test_zero_flag_env_default(monkeypatch):
+    """zero=None reads AUTODIST_ZERO; the flag is registered (GL007)."""
+    from autodist_tpu import const
+    assert "AUTODIST_ZERO" in const.KNOWN_FLAGS
+    monkeypatch.setenv("AUTODIST_ZERO", "1")
+    runner = _session(optax.sgd(0.05), zero=None)
+    assert runner.zero == 1 and runner.plan.zero
+    monkeypatch.setenv("AUTODIST_ZERO", "0")
+    runner = _session(optax.sgd(0.05), zero=None)
+    assert runner.zero == 0 and not runner.plan.zero
+
+
+def test_with_zero_update_plan_rules():
+    """Leaves with no evenly-tiling free axis keep their existing opt spec;
+    tiling ones gain the dp axes; storage (padded) dims decide."""
+    from jax.sharding import PartitionSpec as P
+    import collections
+    mesh_axes = collections.OrderedDict([("data", 4), ("reduce", 1)])
+    params = {
+        "even": ParamPlan(name="even", pspec=P(), opt_pspec=P(),
+                          sync="allreduce", shape=(8, 3)),
+        "odd": ParamPlan(name="odd", pspec=P(), opt_pspec=P(),
+                         sync="allreduce", shape=(3, 5)),
+        "scalar": ParamPlan(name="scalar", pspec=P(), opt_pspec=P(),
+                            sync="allreduce", shape=()),
+    }
+    plan = ShardingPlan(mesh_axes, params).with_zero_update()
+    assert plan.zero
+    assert plan.params["even"].opt_pspec == P(("data", "reduce"), None)
+    assert plan.params["odd"].opt_pspec == P()      # 3 % 4 and 5 % 4 != 0
+    assert plan.params["scalar"].opt_pspec == P()   # nothing to shard
+
+
+# ------------------------------------------------------------ async-PS path
+
+def _ps_session(zero, optimizer=None, **kw):
+    ad = AutoDist(strategy_builder=PS(sync=False))
+    return ad.create_distributed_session(
+        _loss, _params(), optimizer or optax.adam(1e-2),
+        example_batch=_batch(0), zero=zero, **kw)
+
+
+def test_ps_sharded_apply_parity_and_versions():
+    """The S-shard concurrent chief apply lands the same params and the same
+    (re-assembled) optimizer state as the serial whole-tree apply, and the
+    version plane counts per shard: aggregate version = shards x updates."""
+    runs = {}
+    for zero in (0, 4):
+        runner = _ps_session(zero)
+        runner.init(_params())
+        w = runner.worker(0)
+        for i in range(5):
+            w.step(_batch(i), timeout=30)
+        runs[zero] = runner
+    serial, sharded = runs[0].service, runs[4].service
+    assert isinstance(sharded, ShardedParameterService)
+    assert not isinstance(serial, ShardedParameterService)
+    assert sharded.shards == 3  # one per leaf (clamped from 4)
+    assert sharded.shard_versions == [5, 5, 5]
+    assert sharded.version == sharded.shards * 5
+    assert sharded.updates_applied == 5
+    _assert_tree_close(serial.state.params, sharded.state.params,
+                       rtol=1e-5, atol=1e-6)
+    _assert_tree_close(serial.state.opt_state, sharded.state.opt_state,
+                       rtol=1e-5, atol=1e-6)
+    assert int(np.asarray(sharded.state.step)) == 5
+    runs[4].close()
+
+
+def test_ps_default_shard_count_and_off():
+    """zero=1/True picks the default fan-out (clamped to the leaf count);
+    zero=0 keeps the serial service."""
+    r = _ps_session(True)
+    r.init(_params())
+    assert isinstance(r.service, ShardedParameterService)
+    assert r.service.shards == 3
+    r.close()
+    r0 = _ps_session(0)
+    r0.init(_params())
+    assert not isinstance(r0.service, ShardedParameterService)
+
+
+def test_ps_apply_span_fanout():
+    """Each shard apply emits its own ``ps.apply`` span carrying shard/shards
+    args — the cluster-trace view of the concurrent fan-out."""
+    runner = _ps_session(4)
+    runner.init(_params())
+    was = telemetry.enabled()
+    telemetry.enable()
+    telemetry.clear()
+    try:
+        runner.worker(0).step(_batch(0), timeout=30)
+        spans = [s for s in telemetry.snapshot_spans() if s[0] == "ps.apply"]
+        shards = sorted(s[4].get("shard") for s in spans)
+        assert shards == [0, 1, 2], spans
+        assert all(s[4].get("shards") == 3 for s in spans)
+    finally:
+        telemetry.clear()
+        if not was:
+            telemetry.disable()
+    runner.close()
+
+
+def test_ps_staleness_gate_with_sharded_service():
+    """The c9 staleness contract is unchanged under the sharded apply: a fast
+    worker runs exactly ``staleness`` steps ahead, and the aggregate version
+    accounts shards x (all workers' updates)."""
+    staleness = 2
+    ad = AutoDist(strategy_builder=PS(staleness=staleness))
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.05),
+                                           example_batch=_batch(0),
+                                           num_workers=2, zero=4)
+    runner.init(_params())
+    fast, slow = runner.worker(0), runner.worker(1)
+    for _ in range(staleness):
+        fast.step(_batch(0), timeout=30)
+    with pytest.raises(StalenessTimeout):
+        fast.step(_batch(0), timeout=0.2)
+    slow.step(_batch(1), timeout=30)
+    fast.step(_batch(0), timeout=30)
+    assert runner.service.version == runner.service.shards * (
+        fast.steps_completed + slow.steps_completed)
+    runner.close()
+
+
+def test_ps_sharded_restore_reseeds():
+    """reset() re-splits a whole-tree state into the per-shard slices: a
+    restored checkpoint must be what workers pull next."""
+    runner = _ps_session(4)
+    state0 = runner.init(_params())
+    runner.worker(0).step(_batch(0), timeout=30)
+    svc = runner.service
+    ckpt = svc.state    # gathered, unsharded structure
+    runner.worker(0).step(_batch(1), timeout=30)
+    svc.reset(ckpt)
+    _assert_tree_close(svc.state.params, ckpt.params, rtol=0, atol=0)
+    _assert_tree_close(svc.state.opt_state, ckpt.opt_state, rtol=0, atol=0)
+    params0, _, v = svc.read()
+    _assert_tree_close(params0, ckpt.params, rtol=0, atol=0)
+    runner.close()
+    del state0
+
+
+# ------------------------------------------------------------- checkpoints
+
+def test_checkpoint_cross_restore_both_ways(tmp_path):
+    """Gather-on-save: a sharded run's checkpoint holds full logical opt
+    moments and restores into an unsharded run (and vice versa), continuing
+    to the same params as an uninterrupted reference."""
+    ref, _ = _run_steps(_session(optax.adam(1e-2), zero=0), 6)
+
+    # sharded run -> save at 3 -> restore into UNSHARDED run -> 3 more steps
+    r1 = _session(optax.adam(1e-2), zero=1)
+    st, _ = _run_steps(r1, 3)
+    Saver().save(st, str(tmp_path / "m"), global_step=3)
+    z = dict(np.load(str(tmp_path / "m-3.npz")))
+    assert z["__opt__/0/mu/w1"].shape == (D_IN, D_HID)  # full logical shape
+    r0 = _session(optax.adam(1e-2), zero=0)
+    st0 = Saver().restore(str(tmp_path / "m-3"), runner=r0)
+    for i in range(3, 6):
+        st0, _ = r0.run(st0, _batch(i))
+    _assert_tree_close(ref.params, st0.params, rtol=1e-5, atol=1e-6)
+
+    # unsharded run -> save at 3 -> restore into SHARDED run -> 3 more steps
+    rA = _session(optax.adam(1e-2), zero=0)
+    sa, _ = _run_steps(rA, 3)
+    Saver().save(sa, str(tmp_path / "n"), global_step=3)
+    rB = _session(optax.adam(1e-2), zero=1)
+    sb = Saver().restore(str(tmp_path / "n-3"), runner=rB)
+    specs = {str(l.sharding.spec)
+             for l in jax.tree_util.tree_leaves(sb.opt_state)
+             if hasattr(l, "sharding") and l.ndim}
+    assert any("data" in s for s in specs), specs  # restored RE-sharded
+    for i in range(3, 6):
+        sb, _ = rB.run(sb, _batch(i))
+    _assert_tree_close(ref.params, sb.params, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------- telemetry
+
+def test_opt_state_bytes_gauge():
+    """sample_device_memory(opt_state=...) books the train.opt_state_bytes
+    gauge — the number the ZeRO bench divides."""
+    runner = _session(optax.adam(1e-2), zero=1)
+    state = runner.init(_params())
+    was = telemetry.enabled()
+    telemetry.enable()
+    try:
+        wrote = telemetry.sample_device_memory(opt_state=state.opt_state)
+        assert wrote >= 1
+        got = telemetry.registry().snapshot()["train.opt_state_bytes"]
+        assert got == telemetry.opt_state_bytes(state.opt_state) > 0
+    finally:
+        telemetry.clear()
+        if not was:
+            telemetry.disable()

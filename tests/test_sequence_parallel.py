@@ -17,6 +17,7 @@ from autodist_tpu.models import transformer_lm
 from autodist_tpu.parallel.sequence import (create_sequence_parallel_session,
                                             make_sequence_parallel_loss_fn)
 from autodist_tpu.strategy import SequenceParallel
+from shardmap_compat import requires_shard_map
 
 SEQ = 32
 BATCH = 4
@@ -36,6 +37,7 @@ def _batch(cfg, seed=0):
                                           seed=seed)
 
 
+@requires_shard_map
 def test_sp_loss_and_grads_match_single_device():
     """SP loss/grads over a (data=2, seq=4) mesh == the plain single-shard model
     with identical parameters."""
@@ -61,6 +63,7 @@ def test_sp_loss_and_grads_match_single_device():
                                    rtol=2e-4, atol=2e-5)
 
 
+@requires_shard_map
 @pytest.mark.parametrize("tied", [False, True])
 def test_sp_fused_head_matches_plain_sp(tied):
     """The fused pallas head composes with sequence parallelism: same loss and
@@ -90,6 +93,7 @@ def test_sp_fused_head_matches_plain_sp(tied):
                                    rtol=5e-4, atol=5e-5)
 
 
+@requires_shard_map
 def test_sp_training_decreases_loss():
     model, params, cfg = _model("ring")
     batch = _batch(cfg)
@@ -104,6 +108,7 @@ def test_sp_training_decreases_loss():
     assert np.all(np.isfinite(losses))
 
 
+@requires_shard_map
 def test_sp_composes_with_data_parallelism():
     """seq=2 leaves data=4: batch shards over data, sequence over seq, same loss."""
     model_ring, params, cfg = _model("ring")
@@ -119,6 +124,7 @@ def test_sp_composes_with_data_parallelism():
     np.testing.assert_allclose(float(loss_fn(params, batch)), ref, rtol=1e-5)
 
 
+@requires_shard_map
 def test_sp_rejects_indivisible_sequence():
     model, params, cfg = _model("ring")
     ad = AutoDist(strategy_builder=SequenceParallel(seq_axis_size=4))
@@ -146,6 +152,7 @@ def test_sp_rejects_compressor():
         SequenceParallel(seq_axis_size=2, compressor="HorovodCompressor")
 
 
+@requires_shard_map
 def test_sp_rejects_sequence_beyond_max_len():
     """Out-of-range position offsets would silently clamp per-shard; the global
     length check fails loudly instead."""
@@ -160,6 +167,7 @@ def test_sp_rejects_sequence_beyond_max_len():
 
 # ------------------------------------------------------------------ Ulysses
 
+@requires_shard_map
 def test_ulysses_attention_matches_single_device():
     """All-to-all SP: seq-sharded ulysses attention == full attention."""
     from autodist_tpu.parallel.mesh import build_mesh
@@ -175,6 +183,7 @@ def test_ulysses_attention_matches_single_device():
     np.testing.assert_allclose(np.asarray(ul), np.asarray(ref), atol=2e-5)
 
 
+@requires_shard_map
 def test_ulysses_sp_loss_and_grads_match_single_device():
     """Full SP training path with attention_impl='ulysses'."""
     model_ul, params, cfg = _model("ulysses")
@@ -196,6 +205,7 @@ def test_ulysses_sp_loss_and_grads_match_single_device():
                                    rtol=2e-4, atol=2e-5)
 
 
+@requires_shard_map
 def test_ulysses_rejects_indivisible_heads():
     from autodist_tpu.parallel.mesh import build_mesh
     from autodist_tpu.parallel.ulysses import make_ulysses_attention_fn
