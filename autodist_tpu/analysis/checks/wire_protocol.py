@@ -1,12 +1,21 @@
 """GL006 — wire-protocol exhaustiveness and frame-version ordering.
 
-The PS transport is the one place a byte-level mismatch between endpoints
-costs a training run: an opcode the client sends but the server never
-dispatches turns into a per-step "unknown op" error loop; a codec tag with an
-encode arm but no decode arm is a guaranteed ``WireError`` at the first
-message carrying it; and parsing a payload length before validating the
-frame-version byte misreads an incompatible future framing as an absurd
-length (exactly what the PR 2 framing redesign guarded against).
+A byte-level mismatch between wire endpoints costs a whole run: an opcode the
+client sends but the server never dispatches turns into a per-step (or
+per-request) "unknown op" error loop; a codec tag with an encode arm but no
+decode arm is a guaranteed ``WireError`` at the first message carrying it;
+and parsing a payload length before validating the frame-version byte
+misreads an incompatible future framing as an absurd length (exactly what
+the PR 2 framing redesign guarded against).
+
+Two transports speak this wire today — the PS training plane
+(``parallel/ps_transport.py``) and the serving plane
+(``serving/transport.py``) — and the check is deliberately SHAPE-based, not
+path-based: any module pairing ``.call("op", ...)`` client sends with a
+``_dispatch`` arm table gets the same exhaustiveness guarantee, so the next
+transport is covered the day it is written. A module may host several server
+classes (each with its own ``_dispatch``); an op is satisfied when ANY of
+them handles it.
 """
 
 import ast
@@ -89,14 +98,19 @@ def _bytes_tags_compared(fn, var: str) -> Set[bytes]:
 def check_wire_protocol(module: Module, ctx: Context) -> List[Finding]:
     """GL006 — wire-opcode exhaustiveness.
 
-    Three structural invariants of the PS wire (``parallel/wire.py`` +
-    ``parallel/ps_transport.py``), checked wherever the same shapes appear:
+    Three structural invariants of the zero-copy wire (``parallel/wire.py``,
+    spoken by ``parallel/ps_transport.py`` AND the serving transport
+    ``serving/transport.py``), checked wherever the same shapes appear:
 
     - Every opcode a client sends (``.call("op", ...)`` /
       ``.call_raw(("op", ...))``) must have a dispatch arm (``op == "..."``)
-      in the module's ``_dispatch`` function. A missing arm is a per-step
-      error loop at runtime — e.g. adding a ``read_min`` client without the
-      server arm would break every overlapped worker against the new chief.
+      in one of the module's ``_dispatch`` functions (module-level or
+      method; arms union across server classes). A missing arm is a
+      per-step error loop in training and a 100%-error-rate op in serving —
+      e.g. adding a ``read_min`` client without the server arm would break
+      every overlapped worker against the new chief, and a serving client
+      op without an ``InferenceServer._dispatch`` arm rejects every request
+      carrying it.
     - In a codec module (functions named ``_enc``/``_dec``): every one-byte
       tag the encoder emits (``out += b"X"``) must have a decode arm
       (``tag == b"X"``) and vice versa — an asymmetric tag is a guaranteed
@@ -113,22 +127,26 @@ def check_wire_protocol(module: Module, ctx: Context) -> List[Finding]:
     index = callgraph.ModuleIndex(module.tree)
 
     # -- opcode exhaustiveness (gated on a _dispatch function existing) -----
-    dispatch = index.module_funcs.get("_dispatch")
-    if dispatch is None:
-        for (cls, name), fn in index.methods.items():
-            if name == "_dispatch":
-                dispatch = fn
-                break
-    if dispatch is not None:
-        handled = _str_compares(dispatch, "op")
-        if handled:
-            for op, call in _sent_ops(module.tree):
-                if op not in handled:
-                    findings.append(Finding(
-                        "GL006", module.relpath, call.lineno, call.col_offset,
-                        f"opcode {op!r} is sent but `_dispatch` has no arm "
-                        f"for it; every request would error as unknown-op",
-                        scope=module.scope_at(call)))
+    # Union the arms of EVERY _dispatch in the module (module-level function
+    # plus any number of methods): the serving transport hosts its dispatcher
+    # as a server-class method, and a module with several server classes
+    # must not check one client's ops against another class's arm table.
+    dispatchers = []
+    if "_dispatch" in index.module_funcs:
+        dispatchers.append(index.module_funcs["_dispatch"])
+    dispatchers.extend(fn for (cls, name), fn in index.methods.items()
+                       if name == "_dispatch")
+    handled: Set[str] = set()
+    for dispatch in dispatchers:
+        handled |= _str_compares(dispatch, "op")
+    if handled:
+        for op, call in _sent_ops(module.tree):
+            if op not in handled:
+                findings.append(Finding(
+                    "GL006", module.relpath, call.lineno, call.col_offset,
+                    f"opcode {op!r} is sent but `_dispatch` has no arm "
+                    f"for it; every request would error as unknown-op",
+                    scope=module.scope_at(call)))
 
     # -- codec tag symmetry (gated on _enc/_dec both existing) --------------
     enc = index.module_funcs.get("_enc")

@@ -71,6 +71,15 @@ KNOWN_FLAGS = {
                              "silent for 3x this is flagged stalled)",
     "AUTODIST_ZERO": "ZeRO-style weight-update sharding: 0 off (default), "
                      "1 on, N>1 on with N server-side PS apply shards",
+    "AUTODIST_SERVE_ADDR": "inference-server transport host:port for "
+                           "serving clients/examples",
+    "AUTODIST_SERVE_MAX_BATCH": "serving decode-batch slot capacity",
+    "AUTODIST_SERVE_MODE": "serving batcher discipline: 'continuous' "
+                           "(decode-step admission) or 'static' (waves)",
+    "AUTODIST_SERVE_QUEUE": "serving admission-queue bound; beyond it "
+                            "requests are rejected, not parked",
+    "AUTODIST_SERVE_TIMEOUT_S": "server-side cap (seconds) on one serving "
+                                "request's completion wait",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -150,6 +159,15 @@ _ENV_DEFAULTS = {
     # async-PS chiefs apply over the default shard count), N > 1 = on with N
     # concurrent server-side PS apply shards. See DistributedRunner(zero=...).
     "AUTODIST_ZERO": 0,
+    # Serving plane (autodist_tpu/serving): transport address for clients,
+    # decode-batch slot capacity, batching discipline, admission-queue bound,
+    # and the server-side completion-wait cap. ServeConfig.from_env() reads
+    # these; constructor arguments override.
+    "AUTODIST_SERVE_ADDR": "",
+    "AUTODIST_SERVE_MAX_BATCH": 8,
+    "AUTODIST_SERVE_MODE": "continuous",
+    "AUTODIST_SERVE_QUEUE": 256,
+    "AUTODIST_SERVE_TIMEOUT_S": 120.0,
 }
 
 class ENV(enum.Enum):
@@ -180,6 +198,11 @@ class ENV(enum.Enum):
     AUTODIST_WATCHDOG = "AUTODIST_WATCHDOG"
     AUTODIST_WATCHDOG_SEC = "AUTODIST_WATCHDOG_SEC"
     AUTODIST_ZERO = "AUTODIST_ZERO"
+    AUTODIST_SERVE_ADDR = "AUTODIST_SERVE_ADDR"
+    AUTODIST_SERVE_MAX_BATCH = "AUTODIST_SERVE_MAX_BATCH"
+    AUTODIST_SERVE_MODE = "AUTODIST_SERVE_MODE"
+    AUTODIST_SERVE_QUEUE = "AUTODIST_SERVE_QUEUE"
+    AUTODIST_SERVE_TIMEOUT_S = "AUTODIST_SERVE_TIMEOUT_S"
 
     @property
     def val(self):

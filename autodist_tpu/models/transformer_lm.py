@@ -75,7 +75,7 @@ class MultiHeadAttention(nn.Module):
     config: TransformerLMConfig
 
     @nn.compact
-    def __call__(self, x, mask, decode: bool = False):
+    def __call__(self, x, mask, decode: bool = False, decode_pos=None):
         cfg = self.config
         head_dim = cfg.d_model // cfg.n_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -101,20 +101,46 @@ class MultiHeadAttention(nn.Module):
                                cfg.dtype)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
-            idx = ci.value
-            ck.value = jax.lax.dynamic_update_slice_in_dim(
-                ck.value, k.astype(cfg.dtype), idx, axis=1)
-            cv.value = jax.lax.dynamic_update_slice_in_dim(
-                cv.value, v.astype(cfg.dtype), idx, axis=1)
-            ci.value = idx + chunk
-            # Each query (global position idx + i) sees keys [0, idx + i]:
-            # causal within the chunk AND excludes the cache's unwritten tail.
-            q_pos = idx + jnp.arange(chunk)
-            dec_mask = jnp.where(
-                jnp.arange(cfg.max_len)[None, :] <= q_pos[:, None],
-                jnp.zeros((), cfg.dtype), jnp.full((), -1e9, cfg.dtype))
-            ctx = dot_product_attention(q, ck.value, cv.value,
-                                        dec_mask[None, None], cfg.dtype)
+            if decode_pos is not None:
+                # Per-row positions (the serving plane's continuous batcher):
+                # each batch row is an independent sequence parked at its own
+                # write frontier, so the scalar cache_index cannot serve them
+                # all. decode_pos [B] is the authority for write index AND
+                # mask here (cache_index is left untouched — nothing reads it
+                # on this path; the caller owns per-row position bookkeeping).
+                idx_vec = decode_pos.astype(jnp.int32)
+                row_upd = jax.vmap(
+                    lambda cache, new, i: jax.lax.dynamic_update_slice_in_dim(
+                        cache, new, i, axis=0))
+                ck.value = row_upd(ck.value, k.astype(cfg.dtype), idx_vec)
+                cv.value = row_upd(cv.value, v.astype(cfg.dtype), idx_vec)
+                # Row b's query (global position idx_vec[b] + i) sees keys
+                # [0, idx_vec[b] + i] of ITS OWN row only — rows at other
+                # frontiers leave stale/garbage cache beyond their own
+                # frontier, which this mask excludes.
+                q_pos = idx_vec[:, None] + jnp.arange(chunk)[None, :]
+                dec_mask = jnp.where(
+                    jnp.arange(cfg.max_len)[None, None, :]
+                    <= q_pos[:, :, None],
+                    jnp.zeros((), cfg.dtype), jnp.full((), -1e9, cfg.dtype))
+                ctx = dot_product_attention(q, ck.value, cv.value,
+                                            dec_mask[:, None], cfg.dtype)
+            else:
+                idx = ci.value
+                ck.value = jax.lax.dynamic_update_slice_in_dim(
+                    ck.value, k.astype(cfg.dtype), idx, axis=1)
+                cv.value = jax.lax.dynamic_update_slice_in_dim(
+                    cv.value, v.astype(cfg.dtype), idx, axis=1)
+                ci.value = idx + chunk
+                # Each query (global position idx + i) sees keys [0, idx + i]:
+                # causal within the chunk AND excludes the cache's unwritten
+                # tail.
+                q_pos = idx + jnp.arange(chunk)
+                dec_mask = jnp.where(
+                    jnp.arange(cfg.max_len)[None, :] <= q_pos[:, None],
+                    jnp.zeros((), cfg.dtype), jnp.full((), -1e9, cfg.dtype))
+                ctx = dot_product_attention(q, ck.value, cv.value,
+                                            dec_mask[None, None], cfg.dtype)
         elif cfg.attention_impl == "flash":
             from autodist_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True)
@@ -151,10 +177,11 @@ class Block(nn.Module):
     config: TransformerLMConfig
 
     @nn.compact
-    def __call__(self, x, mask, decode: bool = False):
+    def __call__(self, x, mask, decode: bool = False, decode_pos=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
-        x = x + MultiHeadAttention(cfg, name="attn")(h, mask, decode=decode)
+        x = x + MultiHeadAttention(cfg, name="attn")(h, mask, decode=decode,
+                                                     decode_pos=decode_pos)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
                      name="mlp_in", use_bias=False)(h)
@@ -174,6 +201,10 @@ class TransformerLM(nn.Module):
         call sees one sequence shard (the sequence-parallel path passes the ring
         offset so position embeddings stay globally correct) and during
         autoregressive decoding (the generation loop passes the write position).
+        Under ``decode`` it may also be a ``[B]`` int vector giving each batch
+        row its OWN position — the serving plane's continuous batcher, where
+        every slot is an independent request parked at a different frontier;
+        the vector then drives the per-row KV-cache write index and mask too.
         ``return_hidden``: skip the vocab projection and return the final hidden
         states (the fused-head loss owns the projection).
         ``decode``: autoregressive KV-cache mode (run under
@@ -184,8 +215,19 @@ class TransformerLM(nn.Module):
                        param_dtype=jnp.float32, name="embed")
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (cfg.max_len, cfg.d_model), jnp.float32)
-        pos_slice = jax.lax.dynamic_slice_in_dim(pos, pos_offset, length, axis=0)
-        x = emb(tokens) + pos_slice[None].astype(cfg.dtype)
+        decode_pos = None
+        if jnp.ndim(pos_offset) == 1:
+            if not decode:
+                raise ValueError("per-row pos_offset requires decode=True "
+                                 "(the KV-cache path owns per-row positions)")
+            decode_pos = pos_offset
+            pos_idx = decode_pos[:, None] + jnp.arange(length)[None, :]
+            pos_slice = jnp.take(pos, pos_idx, axis=0)        # [B, L, D]
+            x = emb(tokens) + pos_slice.astype(cfg.dtype)
+        else:
+            pos_slice = jax.lax.dynamic_slice_in_dim(pos, pos_offset, length,
+                                                     axis=0)
+            x = emb(tokens) + pos_slice[None].astype(cfg.dtype)
         mask = causal_mask(length, cfg.dtype)
 
         if cfg.remat and not decode:
@@ -198,7 +240,8 @@ class TransformerLM(nn.Module):
                     cfg, name=f"block_{i}")(x, mask)
         else:
             for i in range(cfg.n_layers):
-                x = Block(cfg, name=f"block_{i}")(x, mask, decode=decode)
+                x = Block(cfg, name=f"block_{i}")(x, mask, decode=decode,
+                                                  decode_pos=decode_pos)
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Head matmul in compute dtype: on TPU an f32 [B*S, d, V] matmul runs at

@@ -1,0 +1,43 @@
+"""Production serving plane: continuous-batching inference on the zero-copy
+wire (docs/usage/serving.md).
+
+The repo trains 12 model families; this package serves them. Three layers,
+one subsystem:
+
+- :mod:`autodist_tpu.serving.batcher` — request queue + continuous/static
+  batching loop (jax-free host core; ``ServeConfig`` knobs, bucketed prompt
+  padding, decode-step-granularity admission, early-exit slot reuse).
+- :mod:`autodist_tpu.serving.runtime` — model runtime adapters:
+  ``LMEngine`` drives the Transformer LM's prefill+decode KV-cache path with
+  a shared multi-slot cache; ``ApplyEngine`` jit-applies the stateless
+  classifier/recommender families over padded batches.
+- :mod:`autodist_tpu.serving.transport` — ``InferenceServer`` /
+  ``ServeClient`` speaking new ``generate``/``infer``/``stats``/``ping``
+  opcodes on the PR 2 scatter-gather wire (GL006-covered dispatch).
+
+SLO metrics (``serve.latency_s.*`` ms-bucket histograms, queue/batch gauges,
+request counters) ride :mod:`autodist_tpu.telemetry`; spans appear in the
+PR 5 cluster trace as ``serve.*``.
+
+Typical wiring (see ``examples/serve_lm.py``)::
+
+    config = serving.ServeConfig.from_env(max_batch=8)
+    engine = serving.LMEngine(model, params, config)
+    server = serving.InferenceServer(serving.Batcher(engine, config))
+    client = serving.ServeClient("%s:%d" % server.address)
+    tokens, timing = client.generate(prompt, max_new_tokens=32)
+"""
+
+from autodist_tpu.serving.batcher import (ApplyBatcher, Batcher, ServeConfig,
+                                          ServeError, ServeRequest,
+                                          bucket_for, default_buckets,
+                                          pad_prompt)
+from autodist_tpu.serving.runtime import ApplyEngine, LMEngine
+from autodist_tpu.serving.transport import InferenceServer, ServeClient
+
+__all__ = [
+    "ServeConfig", "ServeError", "ServeRequest",
+    "Batcher", "ApplyBatcher", "LMEngine", "ApplyEngine",
+    "InferenceServer", "ServeClient",
+    "bucket_for", "default_buckets", "pad_prompt",
+]

@@ -1,0 +1,233 @@
+"""Model runtime adapters: the device half of the serving plane.
+
+:class:`LMEngine` drives the flagship Transformer LM's prefill+decode
+KV-cache path (``models/transformer_lm.py``) for continuous batching:
+
+- one SHARED decode cache of ``max_batch`` slots (``[B, max_len, H, D]`` per
+  layer), each slot an independent request parked at its own write frontier
+  — the per-row ``pos_offset`` vector added to the model's decode path
+  carries every slot's position through ONE compiled step;
+- per-bucket jitted PREFILL programs (prompt right-padded to its bucket; the
+  pad tail's K/V is masked until decode overwrites it position by position,
+  so results are bit-identical to an unpadded prefill);
+- a jitted INSERT that scatters a prefilled single-request cache into the
+  shared cache's slot row — admission at decode-step granularity without
+  recompiling anything;
+- slot REUSE without scrubbing: a freed slot's stale K/V beyond the next
+  occupant's frontier is never unmasked, and everything below it is
+  overwritten by the occupant's own prefill.
+
+:class:`ApplyEngine` is the stateless counterpart for the classifier /
+recommender families: stack the gathered examples, pad the batch dim to a
+power-of-two bucket (bounded jit cache), one jitted ``apply``, split.
+
+Both engines hold the jit cache keyed by bucket so the compile count is
+``len(buckets) + 2`` for the LM (prefills + decode + insert) and
+``log2(max_batch)`` for apply — the continuous batcher's admission churn
+never compiles.
+"""
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import telemetry
+from autodist_tpu.serving.batcher import (ServeConfig, bucket_for,
+                                          default_buckets, pad_prompt)
+
+
+class LMEngine:
+    """Continuous-batching decode engine over a Transformer LM.
+
+    ``params`` are used as placed (replicated or sharded — XLA inserts any
+    collectives, same contract as :func:`transformer_lm.generate`). Position
+    bookkeeping lives HERE, host-side (``pos[slot]`` = the cache row's write
+    frontier = tokens so far for that request); the model's per-row
+    ``pos_offset`` vector is fed from it every step.
+    """
+
+    def __init__(self, model, params, config: Optional[ServeConfig] = None):
+        config = config or ServeConfig()
+        self.model = model
+        self.config = config
+        self._params = params
+        cfg = model.config
+        self.capacity = config.max_batch
+        self.max_len = cfg.max_len
+        self.buckets = tuple(b for b in (config.buckets
+                                         or default_buckets(cfg.max_len))
+                             if b <= cfg.max_len)
+        if not self.buckets:
+            raise ValueError(f"no pad bucket fits max_len {cfg.max_len}")
+        self._sampling = (float(config.temperature), int(config.top_k),
+                          float(config.top_p))
+        B = self.capacity
+        self._pos = np.zeros(B, np.int32)       # per-slot write frontier
+        self._active = np.zeros(B, bool)
+        self._last = np.zeros(B, np.int32)      # last sampled token per slot
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._decode_fn = self._make_decode()
+        # The shared cache is donated through insert for the same reason
+        # decode donates it: it dominates serving HBM, and an undonated
+        # insert would copy the whole cache per admission (callers rebind on
+        # the same line).
+        self._insert_fn = jax.jit(self._insert_slot, donate_argnums=(0,))
+        # Shared decode cache: created by one dummy decode apply (writes junk
+        # at position 0, overwritten by the first admission's prefill).
+        _, variables = model.apply(
+            {"params": params}, jnp.zeros((B, 1), jnp.int32),
+            decode=True, mutable=["cache"])
+        self._cache = variables["cache"]
+
+    # ------------------------------------------------------------- jit cache
+
+    def _make_decode(self):
+        model, (temp, top_k, top_p) = self.model, self._sampling
+        from autodist_tpu.models.common import sample_logits
+
+        def decode_step(params, cache, toks, pos, keys):
+            logits, variables = model.apply(
+                {"params": params, "cache": cache}, toks[:, None],
+                pos_offset=pos, decode=True, mutable=["cache"])
+            lg = logits[:, 0]                                  # [B, V]
+            if temp == 0.0:
+                nxt = sample_logits(lg, None, 0.0)
+            else:
+                # Per-row keys: every slot samples from ITS request's key
+                # schedule, so a slot's token stream is independent of who
+                # shares the batch (and bit-matches the batch-1 run).
+                nxt = jax.vmap(lambda l, k: sample_logits(
+                    l[None], k, temp, top_k, top_p)[0])(lg, keys)
+            return variables["cache"], nxt
+
+        # The cache is donated: at real sizes it dominates serving HBM and
+        # every step rewrites it (callers rebind on the same line).
+        return jax.jit(decode_step, donate_argnums=(1,))
+
+    def _prefill(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, (temp, top_k, top_p) = self.model, self._sampling
+        tied = model.config.tied_output
+        from autodist_tpu.models.common import lm_head_logits, sample_logits
+
+        def prefill(params, padded, plen, key):
+            # Whole padded prompt in one decode apply (the chunked cache
+            # write); only the LAST REAL position's logits are projected, so
+            # the [1, L, V] tensor never materializes — same trick as
+            # transformer_lm.generate's prefill.
+            hidden, variables = model.apply(
+                {"params": params}, padded, pos_offset=0, decode=True,
+                return_hidden=True, mutable=["cache"])
+            last_h = jax.lax.dynamic_slice_in_dim(hidden, plen - 1, 1,
+                                                  axis=1)[:, 0]
+            lg = lm_head_logits(last_h, params, tied=tied)
+            return variables["cache"], sample_logits(lg, key, temp, top_k,
+                                                     top_p)[0]
+
+        fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        return fn
+
+    @staticmethod
+    def _insert_slot(dec_cache, pre_cache, slot):
+        """Scatter a [1, ...] prefilled cache into slot row ``slot`` of the
+        shared [B, ...] cache (scalar leaves — the unused cache_index — keep
+        the shared value)."""
+        return jax.tree_util.tree_map(
+            lambda d, p: d if p.ndim == 0
+            else jax.lax.dynamic_update_slice_in_dim(d, p, slot, axis=0),
+            dec_cache, pre_cache)
+
+    # ------------------------------------------------------ engine interface
+
+    def make_keys(self, seed: int, n: int) -> Optional[np.ndarray]:
+        """The request's per-step sampling key schedule — ``split(key, n)``,
+        the SAME schedule :func:`transformer_lm.generate` uses, so a served
+        request at batch 1 reproduces ``generate()`` bit for bit. Greedy
+        engines return None (argmax needs no keys)."""
+        if self._sampling[0] == 0.0:
+            return None
+        return np.asarray(jax.random.split(jax.random.PRNGKey(seed), n))
+
+    def admit(self, slot: int, prompt: np.ndarray,
+              key: Optional[np.ndarray]) -> int:
+        """Prefill ``prompt`` into ``slot``; returns the first sampled token.
+        The prompt is right-padded to its bucket — pad K/V beyond the true
+        length is masked now and overwritten by decode steps later, so
+        padding never changes results."""
+        plen = int(prompt.size)
+        bucket = bucket_for(plen, self.buckets)
+        padded = pad_prompt(prompt, bucket)
+        key = jnp.zeros((2,), jnp.uint32) if key is None else key
+        cache1, first = self._prefill(bucket)(
+            self._params, padded, np.int32(plen), key)
+        self._cache = self._insert_fn(self._cache, cache1, np.int32(slot))
+        first = int(jax.device_get(first))
+        self._pos[slot] = plen
+        self._active[slot] = True
+        self._last[slot] = first
+        return first
+
+    def step(self, keys: Optional[np.ndarray] = None) -> np.ndarray:
+        """One decode step for EVERY slot (inactive rows compute garbage at
+        position 0, masked for any later occupant); returns the [B] sampled
+        tokens. Frontiers advance for active slots only."""
+        if keys is None:
+            keys = np.zeros((self.capacity, 2), np.uint32)
+        self._cache, toks = self._decode_fn(
+            self._params, self._cache, self._last, self._pos, keys)
+        toks = np.asarray(jax.device_get(toks))
+        self._pos = np.where(self._active, self._pos + 1, 0).astype(np.int32)
+        self._last = np.where(self._active, toks, 0).astype(np.int32)
+        return toks
+
+    def free(self, slot: int):
+        """Release a slot (early exit / completion). No cache scrub: the next
+        occupant's prefill overwrites [0, bucket) and its mask never reaches
+        past its own frontier, and idle rows park their writes at position 0
+        which every prefill overwrites too."""
+        self._active[slot] = False
+        self._pos[slot] = 0
+        self._last[slot] = 0
+
+    @property
+    def num_active(self) -> int:
+        return int(self._active.sum())
+
+    def compiled_programs(self) -> Tuple[int, int]:
+        """(prefill programs, total jitted entry points) — the jit-cache
+        boundedness the bucketing exists for; tests pin it."""
+        return len(self._prefill_fns), len(self._prefill_fns) + 2
+
+
+class ApplyEngine:
+    """Stateless inference engine: ``apply_fn(params, stacked_examples) ->
+    stacked_outputs`` jitted per power-of-two batch bucket. Examples are
+    pytrees of ndarrays WITHOUT a batch dim (one example each); outputs are
+    split back one per request."""
+
+    def __init__(self, apply_fn, params, config: Optional[ServeConfig] = None):
+        config = config or ServeConfig()
+        self.config = config
+        self.capacity = config.max_batch
+        self._params = params
+        self._apply = jax.jit(apply_fn)
+
+    def run(self, examples: List) -> List:
+        n = len(examples)
+        # Pad the batch dim to the next power of two (bounded jit cache) by
+        # repeating the last example; padded outputs are dropped.
+        padded_n = 1
+        while padded_n < n:
+            padded_n *= 2
+        batch = examples + [examples[-1]] * (padded_n - n)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *batch)
+        with telemetry.span("serve.apply_dispatch", batch=n, padded=padded_n):
+            out = self._apply(self._params, stacked)
+        out = jax.device_get(out)
+        return [jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
+                for i in range(n)]

@@ -1,0 +1,256 @@
+"""Serving transport: request/response opcodes on the PR 2 zero-copy wire.
+
+The PS transport's plane is reused wholesale — typed codec
+(``parallel/wire.py``: nothing on the socket is ever unpickled),
+scatter-gather ``sendmsg`` sends, recycled ``recv_into`` buffers, 8-byte
+version-validated framing — with a new opcode vocabulary for online
+inference:
+
+- ``generate`` — LM generation: ``(op, prompt int32[P], max_new_tokens,
+  seed, timeout)`` -> ``("ok", tokens int32[T], timing)``. The handler
+  thread enqueues into the continuous batcher and parks (bounded) on the
+  request's completion event; the socket is idle while the batch cooks, so
+  a slow generation never blocks other connections (thread-per-connection,
+  the same property the PS gate relies on).
+- ``infer`` — stateless model apply: ``(op, example-pytree, timeout)`` ->
+  ``("ok", output-pytree, timing)``.
+- ``stats`` — the serving SLO snapshot (telemetry registry + queue/batch
+  state), remote observability without grepping the server's log.
+- ``ping`` — health/liveness echo.
+
+Every arm is covered by graftlint GL006 (client-op/dispatch-arm symmetry):
+an opcode the client sends without a server arm fails lint, same as the PS
+wire. Malformed payloads (wrong types, oversize prompts, full queue) get an
+``("error", kind, detail)`` reply — a hostile peer achieves data parsing and
+its own rejection, nothing more.
+"""
+
+import socketserver
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from autodist_tpu import telemetry
+from autodist_tpu.parallel import wire
+from autodist_tpu.parallel.ps_transport import (_PSClient, _RecvBuffer,
+                                                _recv_msg, _send_payload,
+                                                PSClientError)
+from autodist_tpu.serving.batcher import ServeError
+from autodist_tpu.utils import logging
+from autodist_tpu.utils.metrics import WireCounters
+
+# Hard ceiling on one request's server-side completion wait: a vanished
+# batcher must not park a handler thread forever (GL005's rule at the trust
+# boundary); a single generation this long is operationally dead anyway.
+MAX_WAIT_S = 600.0
+
+
+def _env_address() -> Tuple[str, int]:
+    """The ``AUTODIST_SERVE_ADDR`` default: ``host:port`` when the flag is
+    set, else loopback on an ephemeral port. Server bind and client target
+    share it, so one exported flag points both ends at the same place."""
+    from autodist_tpu import const
+    addr = str(const.ENV.AUTODIST_SERVE_ADDR.val)
+    if not addr:
+        return "127.0.0.1", 0
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        return addr, 0
+    return host, int(port)
+
+
+class InferenceServer:
+    """Serve a batcher (LM :class:`~autodist_tpu.serving.batcher.Batcher` or
+    :class:`~autodist_tpu.serving.batcher.ApplyBatcher`) to remote clients.
+
+    Same trust model as the PS transport: the wire is typed (no code
+    execution on decode) but unauthenticated — binding wider than loopback /
+    the cluster's trust domain is the caller's explicit choice (defaults:
+    ``AUTODIST_SERVE_ADDR`` when set, else loopback on an ephemeral port)."""
+
+    def __init__(self, batcher, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        env_host, env_port = _env_address()
+        host = env_host if host is None else host
+        port = env_port if port is None else port
+        self._batcher = batcher
+        self._t_started = time.monotonic()
+        self.wire = WireCounters()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                pool = _RecvBuffer()
+                try:
+                    while True:
+                        msg, _ = _recv_msg(self.request, pool=pool,
+                                           counters=outer.wire)
+                        is_protocol = isinstance(msg, tuple) and bool(msg)
+                        op = msg[0] if is_protocol else "<malformed>"
+                        with telemetry.span("serve.request", op=str(op)):
+                            reply = outer._dispatch(msg)
+                        try:
+                            payload = wire.encode_parts(reply)
+                        except wire.WireError as e:
+                            # OUR reply is unencodable (e.g. a model output
+                            # pytree with an unregistered node) — a server
+                            # limitation, not a hostile peer: report it.
+                            logging.warning(
+                                "serve transport: reply to %r is not "
+                                "wire-encodable (%s)", op, e)
+                            payload = wire.encode_parts((
+                                "error", "WireError",
+                                f"server reply to {op!r} is not "
+                                f"wire-encodable: {e}"))
+                        n = _send_payload(self.request, payload)
+                        outer.wire.add_sent(n)
+                        # Drop aliases into the recv buffer before the next
+                        # recv so the pool can recycle it.
+                        msg = reply = payload = None
+                except wire.WireError as e:
+                    logging.warning("serve transport: dropping connection "
+                                    "with malformed payload (%s)", e)
+                except (ConnectionError, OSError):
+                    pass  # client went away; its requests complete unobserved
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logging.info("InferenceServer (%s batcher, %s mode) listening on "
+                     "%s:%d", batcher.kind, batcher.config.mode,
+                     *self._server.server_address)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def stats_snapshot(self) -> dict:
+        """Wire-encodable serving snapshot: the telemetry registry (the
+        ``serve.*`` SLO families live there), queue/batch state, uptime."""
+        return {"registry": telemetry.snapshot(),
+                "wire": self.wire.snapshot(),
+                "uptime_s": round(time.monotonic() - self._t_started, 3),
+                "mode": self._batcher.config.mode,
+                "kind": self._batcher.kind,
+                "capacity": self._batcher._engine.capacity,
+                "queue_depth": self._batcher.queue_depth()}
+
+    def _wait(self, req, timeout) -> tuple:
+        """Park this handler thread (bounded) until the batcher completes the
+        request, then build the reply."""
+        limit = self._batcher.config.request_timeout_s
+        if timeout is not None:
+            limit = min(float(timeout), limit)
+        if not req.done.wait(timeout=min(limit, MAX_WAIT_S)):
+            # Nobody will read this result: tell the batcher to drop the
+            # request at its next scheduling round instead of decoding a
+            # full generation into the void.
+            req.abandon()
+            return ("error", "ServeTimeout",
+                    f"request {req.rid} did not complete within {limit:.1f}s")
+        if req.error is not None:
+            return ("error", "ServeError", req.error)
+        if self._batcher.kind == "lm":
+            return ("ok", np.asarray(req.tokens, np.int32), req.timing())
+        return ("ok", req.output, req.timing())
+
+    def _dispatch(self, msg):
+        # A peer can legally encode a bare dict/int/None; reject it as a
+        # protocol error instead of raising outside the per-op try.
+        if not isinstance(msg, tuple) or not msg \
+                or not isinstance(msg[0], str):
+            return ("error", "ServeError",
+                    f"malformed protocol message: expected (op, ...) tuple, "
+                    f"got {type(msg).__name__}")
+        op = msg[0]
+        try:
+            if op == "generate":
+                if self._batcher.kind != "lm":
+                    raise ServeError("this server hosts a stateless apply "
+                                     "batcher; use the 'infer' op")
+                _, prompt, max_new, seed, timeout = msg
+                req = self._batcher.submit(prompt, max_new, seed=int(seed))
+                return self._wait(req, timeout)
+            if op == "infer":
+                if self._batcher.kind != "apply":
+                    raise ServeError("this server hosts an LM batcher; use "
+                                     "the 'generate' op")
+                _, example, timeout = msg
+                req = self._batcher.submit(example)
+                return self._wait(req, timeout)
+            if op == "stats":
+                return ("ok", self.stats_snapshot())
+            if op == "ping":
+                return ("ok", msg[1] if len(msg) > 1 else None,
+                        time.time_ns())
+            return ("error", "ServeError", f"unknown op {op!r}")
+        except Exception as e:  # ship the failure to the client, keep serving
+            return ("error", type(e).__name__, str(e))
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._batcher.close()
+        if self.wire.msgs_received:
+            logging.info("InferenceServer closed: %s | up %.1fs",
+                         self.wire.format_line(),
+                         time.monotonic() - self._t_started)
+
+
+class ServeClient:
+    """A client handle onto an :class:`InferenceServer`.
+
+    One in-flight request per client (the underlying connection pairs one
+    request with one reply); concurrency = one client per thread, each its
+    own connection — the loopback examples and the serving bench do exactly
+    that."""
+
+    def __init__(self, address=None, connect_timeout: float = 60.0):
+        if address is None:
+            address = _env_address()   # the AUTODIST_SERVE_ADDR default
+        self._client = _PSClient(address, connect_timeout=connect_timeout)
+
+    @property
+    def wire(self) -> WireCounters:
+        return self._client.wire
+
+    def generate(self, prompt, max_new_tokens: int, seed: int = 0,
+                 timeout: Optional[float] = None):
+        """``prompt`` (1-D int array-like) -> ``(tokens int32[T], timing)``
+        where timing is the server's ``{queue,prefill,decode,total}_s``
+        breakdown. Raises :class:`ServeError` on rejection."""
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        try:
+            tokens, timing = self._client.call(
+                "generate", prompt, int(max_new_tokens), int(seed), timeout)
+        except PSClientError as e:
+            raise ServeError(str(e)) from None
+        return np.asarray(tokens), timing
+
+    def infer(self, example, timeout: Optional[float] = None):
+        """One stateless-apply request: ``example`` (pytree of ndarrays,
+        no batch dim) -> ``(output, timing)``."""
+        try:
+            output, timing = self._client.call("infer", example, timeout)
+        except PSClientError as e:
+            raise ServeError(str(e)) from None
+        return output, timing
+
+    def stats(self) -> dict:
+        return self._client.call("stats")[0]
+
+    def ping(self) -> float:
+        """Round-trip seconds to the server (health check)."""
+        t0 = time.perf_counter()
+        self._client.call("ping", time.time_ns())
+        return time.perf_counter() - t0
+
+    def close(self):
+        self._client.close()

@@ -21,7 +21,8 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
-           "counter", "gauge", "histogram", "snapshot", "event", "events"]
+           "counter", "gauge", "histogram", "snapshot", "event", "events",
+           "family_buckets"]
 
 Number = Union[int, float]
 
@@ -30,6 +31,32 @@ Number = Union[int, float]
 SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
 # For small-integer distributions (staleness lag, queue depths).
 COUNT_BUCKETS = (0, 1, 2, 4, 8, 16, 32)
+# Millisecond-scale edges for online-serving latencies (0.5ms .. 2.5s): the
+# step-time default above puts everything under 1ms in one bucket, which is
+# where a whole loopback serving distribution lives.
+MS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1.0, 2.5)
+
+# Per-family default-bucket overrides, keyed by metric-name prefix (a family
+# matches ``name == prefix`` or ``name.startswith(prefix + '.')``; the
+# longest match wins). Histograms created WITHOUT explicit buckets resolve
+# their family here, so e.g. every ``serve.latency_s.*`` instrument gets
+# ms-scale edges without each call site repeating them. Names outside every
+# family keep SECONDS_BUCKETS — the pre-existing default is unchanged.
+BUCKET_FAMILIES: Dict[str, Tuple[Number, ...]] = {
+    "serve.latency_s": MS_BUCKETS,
+}
+
+
+def family_buckets(name: str) -> Tuple[Number, ...]:
+    """The default bucket edges for ``name``: its longest matching family in
+    :data:`BUCKET_FAMILIES`, else :data:`SECONDS_BUCKETS`."""
+    best: Optional[str] = None
+    for prefix in BUCKET_FAMILIES:
+        if (name == prefix or name.startswith(prefix + ".")) \
+                and (best is None or len(prefix) > len(best)):
+            best = prefix
+    return BUCKET_FAMILIES[best] if best is not None else SECONDS_BUCKETS
 
 
 class Counter:
@@ -175,7 +202,7 @@ class Registry:
 
     def histogram(self, name: str,
                   buckets: Optional[Sequence[Number]] = None) -> Histogram:
-        return self._get(name, Histogram, buckets or SECONDS_BUCKETS)
+        return self._get(name, Histogram, buckets or family_buckets(name))
 
     def snapshot(self) -> Dict[str, object]:
         """``{name: value-or-histogram-dict}``, keys sorted — deterministic

@@ -102,6 +102,9 @@ python bench.py --telemetry-overhead
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead
+# Serving plane gate: continuous batching must beat static wave batching
+# on loopback requests/s at equal-or-better p99 (serving row).
+python bench.py --serve
 python bench.py
 
 echo "=== CI OK ==="

@@ -1,0 +1,511 @@
+"""Serving plane (autodist_tpu.serving): batcher, engines, wire, SLO metrics.
+
+NAMED to sort inside the tier-1 alphabetical window (after test_aux, before
+test_image_data). No subprocesses: the loopback legs run server + client in
+THIS process over 127.0.0.1, the same pattern the PS transport tests use.
+
+Coverage per the PR 7 contract:
+- packing/bucketing units (jax-free, driven by a fake engine);
+- early-exit slot reuse at decode-step granularity (continuous) vs wave
+  admission (static);
+- batch-1 served output is BIT-IDENTICAL to direct ``generate()`` /
+  model ``apply`` for a fixed key (greedy and sampled);
+- multi-slot continuous decode matches each request's batch-1 reference;
+- wire round-trip including malformed-request rejection;
+- ``serve.*`` SLO metric families present in ``telemetry.snapshot()`` with
+  ms-scale bucket edges resolved via ``metrics.BUCKET_FAMILIES``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from autodist_tpu import serving, telemetry  # noqa: E402
+from autodist_tpu.models import transformer_lm  # noqa: E402
+from autodist_tpu.models.transformer_lm import (TransformerLMConfig,  # noqa: E402
+                                                generate)
+from autodist_tpu.serving import (Batcher, LMEngine, ServeConfig,  # noqa: E402
+                                  ServeError, bucket_for, default_buckets,
+                                  pad_prompt)
+
+
+# ------------------------------------------------------------------ fixtures
+
+def _small_cfg(**kw):
+    kw.setdefault("vocab_size", 97)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("dtype", jnp.float32)   # exact-comparison friendly
+    return TransformerLMConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = _small_cfg()
+    model, params = transformer_lm.init_params(cfg)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def greedy_engine(lm):
+    """One shared greedy engine (capacity 2) — jit programs compile once for
+    the whole module; tests free every slot they use."""
+    model, params = lm
+    return LMEngine(model, params, ServeConfig(max_batch=2))
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 97, size=n).astype(np.int32)
+
+
+def _drive(batcher, reqs, rounds=80):
+    for _ in range(rounds):
+        if all(r.done.is_set() for r in reqs):
+            break
+        batcher.run_once()
+    assert all(r.done.is_set() for r in reqs), "batcher did not converge"
+
+
+# ------------------------------------------------- bucketing / packing units
+
+def test_default_buckets_power_of_two_with_max_cap():
+    assert default_buckets(32) == (8, 16, 32)
+    assert default_buckets(48) == (8, 16, 32, 48)   # non-pow2 max included
+    assert default_buckets(8) == (8,)
+
+
+def test_bucket_for_picks_smallest_fit_and_rejects_oversize():
+    assert bucket_for(3, (8, 16)) == 8
+    assert bucket_for(8, (8, 16)) == 8              # boundary lands in-bucket
+    assert bucket_for(9, (8, 16)) == 16
+    with pytest.raises(ServeError):
+        bucket_for(17, (8, 16))
+
+
+def test_pad_prompt_right_pads_to_bucket():
+    p = np.array([5, 6, 7], np.int32)
+    out = pad_prompt(p, 8)
+    assert out.shape == (1, 8) and out.dtype == np.int32
+    assert list(out[0]) == [5, 6, 7, 0, 0, 0, 0, 0]
+
+
+def test_serve_config_validates_and_reads_env(monkeypatch):
+    with pytest.raises(ValueError):
+        ServeConfig(mode="adaptive")
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=0)
+    with pytest.raises(ValueError):
+        ServeConfig(buckets=(16, 8))
+    monkeypatch.setenv("AUTODIST_SERVE_MAX_BATCH", "5")
+    monkeypatch.setenv("AUTODIST_SERVE_MODE", "static")
+    cfg = ServeConfig.from_env(max_queue=7)
+    assert cfg.max_batch == 5 and cfg.mode == "static" and cfg.max_queue == 7
+
+
+def test_transport_env_address_default(monkeypatch):
+    """AUTODIST_SERVE_ADDR is the shared server-bind / client-target default;
+    unset means loopback on an ephemeral port."""
+    from autodist_tpu.serving import transport
+    monkeypatch.delenv("AUTODIST_SERVE_ADDR", raising=False)
+    assert transport._env_address() == ("127.0.0.1", 0)
+    monkeypatch.setenv("AUTODIST_SERVE_ADDR", "10.0.0.5:7701")
+    assert transport._env_address() == ("10.0.0.5", 7701)
+
+
+# --------------------------------------------- fake-engine batcher semantics
+
+class FakeEngine:
+    """Deterministic jax-free engine: token = 100*slot + step index. Records
+    every admit/free so tests can assert slot-reuse order."""
+
+    def __init__(self, capacity=2, max_len=32):
+        self.capacity = capacity
+        self.max_len = max_len
+        self.buckets = default_buckets(max_len)
+        self.admits = []                  # (slot, prompt_len) in admit order
+        self.freed = []
+        self._steps = np.zeros(capacity, np.int64)
+
+    def make_keys(self, seed, n):
+        return None
+
+    def admit(self, slot, prompt, key):
+        self.admits.append((slot, int(prompt.size)))
+        self._steps[slot] = 0
+        return 100 * slot
+
+    def step(self, keys):
+        self._steps += 1
+        return (100 * np.arange(self.capacity) + self._steps).astype(np.int32)
+
+    def free(self, slot):
+        self.freed.append(slot)
+
+
+def test_continuous_early_exit_frees_slot_for_waiter():
+    """Capacity 2, three requests; the SHORT one exits early and its slot is
+    reused by the waiter at decode-step granularity — the long request never
+    drains first (no convoy)."""
+    eng = FakeEngine(capacity=2)
+    b = Batcher(eng, ServeConfig(max_batch=2), start=False)
+    r_long = b.submit(_prompt(4), 8)
+    r_short = b.submit(_prompt(3), 2)
+    r_wait = b.submit(_prompt(5), 2)
+    b.run_once()                  # admits long+short; short: 2 tokens after 1 step
+    assert [s for s, _ in eng.admits] == [0, 1]
+    assert b.queue_depth() == 1   # waiter still queued
+    b.run_once()                  # short finished last round -> waiter admitted
+    assert r_short.done.is_set() and not r_long.done.is_set()
+    assert eng.admits[2][0] == eng.freed[0]      # reused the freed slot
+    _drive(b, [r_long, r_wait])
+    assert not r_long.error and not r_wait.error
+    # Token streams: admit token then per-step tokens for the request's slot.
+    assert r_short.tokens[0] == 100 * r_short.slot
+    assert len(r_long.tokens) == 8 and len(r_wait.tokens) == 2
+
+
+def test_static_mode_admits_only_full_waves():
+    eng = FakeEngine(capacity=2)
+    b = Batcher(eng, ServeConfig(max_batch=2, mode="static"), start=False)
+    reqs = [b.submit(_prompt(3), n) for n in (2, 4, 2)]
+    b.run_once()
+    assert len(eng.admits) == 2          # wave of 2 admitted
+    b.run_once()                         # first short request done; one slot free
+    assert len(eng.admits) == 2          # static: NO mid-wave admission
+    _drive(b, reqs)
+    assert len(eng.admits) == 3          # third admitted only after the drain
+
+
+def test_queue_full_rejects_instantly():
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1, max_queue=1), start=False)
+    b.submit(_prompt(3), 2)
+    with pytest.raises(ServeError, match="queue is full"):
+        b.submit(_prompt(3), 2)
+
+
+def test_submit_validation_rejects_malformed_requests():
+    eng = FakeEngine(capacity=1, max_len=32)
+    b = Batcher(eng, ServeConfig(max_batch=1), start=False)
+    with pytest.raises(ServeError, match="1-D integer"):
+        b.submit(np.zeros((2, 3), np.int32), 2)           # wrong rank
+    with pytest.raises(ServeError, match="1-D integer"):
+        b.submit(np.zeros(3, np.float32), 2)              # wrong dtype
+    with pytest.raises(ServeError, match="positive int"):
+        b.submit(_prompt(3), 0)                           # no tokens asked
+    with pytest.raises(ServeError, match="exceeds"):
+        b.submit(_prompt(3), 64)                          # cache overflow
+    with pytest.raises(ServeError, match="pad bucket"):
+        b.submit(_prompt(33), 1)                          # oversize prompt
+
+
+def test_eos_stops_generation_early(lm):
+    """A fake engine emitting the configured EOS id ends the request before
+    its token budget; the EOS is the last emitted token."""
+
+    class EosEngine(FakeEngine):
+        def step(self, keys):
+            toks = super().step(keys)
+            return np.where(self._steps == 2, 7, toks).astype(np.int32)
+
+    eng = EosEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1, eos_id=7), start=False)
+    req = b.submit(_prompt(3), 10)
+    _drive(b, [req])
+    assert req.tokens[-1] == 7 and len(req.tokens) == 3   # admit + 2 steps
+
+
+def test_close_fails_pending_requests():
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1), start=False)
+    req = b.submit(_prompt(3), 4)
+    b.close()
+    assert req.done.is_set() and "shutting down" in req.error
+
+
+def test_abandoned_queued_request_never_reaches_the_device():
+    """A waiter whose client gave up (transport timeout -> abandon()) is
+    dropped at the admission pop: no prefill, no decode, slot goes to the
+    next live waiter."""
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1), start=False)
+    r_active = b.submit(_prompt(3), 3)
+    r_dead = b.submit(_prompt(4), 3)
+    r_live = b.submit(_prompt(5), 2)
+    r_dead.abandon()
+    _drive(b, [r_active, r_dead, r_live])
+    assert "abandoned" in r_dead.error and not r_dead.tokens
+    assert r_live.error is None and len(r_live.tokens) == 2
+    # Only the two live requests were ever admitted (prompt lens 3 and 5).
+    assert [n for _, n in eng.admits] == [3, 5]
+
+
+def test_abandoned_inflight_request_frees_its_slot_early():
+    """An active request whose client gave up leaves the batch at the next
+    scheduling round — its remaining decode budget goes to the waiter."""
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1), start=False)
+    r_dead = b.submit(_prompt(3), 20)
+    r_live = b.submit(_prompt(4), 2)
+    b.run_once()                  # admits r_dead, one decode step
+    assert r_dead.slot == 0 and not r_dead.done.is_set()
+    r_dead.abandon()
+    b.run_once()                  # drop r_dead, slot refilled by r_live
+    assert r_dead.done.is_set() and "abandoned" in r_dead.error
+    assert len(r_dead.tokens) < 20
+    _drive(b, [r_live])
+    assert r_live.error is None and eng.freed[0] == 0
+
+
+def test_expired_inflight_request_is_dropped_mid_generation():
+    """Deadline expiry applies to ADMITTED requests too (no transport, so
+    nothing calls abandon()): a slow generation past request_timeout_s frees
+    its slot at the next decode round."""
+    import time as _time
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1, request_timeout_s=0.05),
+                start=False)
+    req = b.submit(_prompt(3), 20)
+    b.run_once()                  # admitted before the deadline check matters
+    assert req.slot == 0 and not req.done.is_set()
+    _time.sleep(0.1)              # deadline passes mid-generation
+    b.run_once()
+    assert req.done.is_set() and "timed out" in req.error
+    assert len(req.tokens) < 20 and eng.freed == [0]
+
+
+def test_submit_after_close_rejects_instantly():
+    """A request arriving after close() gets an immediate rejection, not a
+    full-timeout park on a queue nobody drains."""
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1), start=False)
+    b.close()
+    with pytest.raises(ServeError, match="shutting down"):
+        b.submit(_prompt(3), 2)
+
+
+def test_expired_queued_request_is_dropped_at_admission():
+    """A waiter that outlived request_timeout_s in the queue is dropped at
+    the pop instead of burning decode on a reply nobody is waiting for."""
+    import time as _time
+    eng = FakeEngine(capacity=1)
+    b = Batcher(eng, ServeConfig(max_batch=1, request_timeout_s=0.005),
+                start=False)
+    r1 = b.submit(_prompt(3), 2)
+    r2 = b.submit(_prompt(4), 2)
+    _time.sleep(0.02)             # both deadlines pass while queued
+    b.run_once()
+    assert "timed out" in r1.error and "timed out" in r2.error
+    assert not eng.admits
+
+
+# ----------------------------------------------------- LM engine parity legs
+
+def test_batch1_greedy_parity_vs_generate(lm, greedy_engine):
+    """Served greedy output == direct generate() bit for bit (the KV-cache
+    slot path, padded prefill and per-row decode positions included)."""
+    model, params = lm
+    b = Batcher(greedy_engine, greedy_engine.config, start=False)
+    prompt = _prompt(7, seed=1)
+    ref = np.asarray(generate(model, params, jnp.asarray(prompt[None]), 8))[0]
+    req = b.submit(prompt, 8)
+    _drive(b, [req])
+    assert req.error is None
+    np.testing.assert_array_equal(ref, np.asarray(req.tokens, np.int32))
+
+
+def test_batch1_sampled_parity_vs_generate(lm):
+    """Sampled path: the engine replays generate()'s exact per-step key
+    schedule for the request's seed, so the served stream is bit-identical
+    even though other requests share the decode batch."""
+    model, params = lm
+    scfg = ServeConfig(max_batch=2, temperature=0.8, top_k=5)
+    eng = LMEngine(model, params, scfg)
+    b = Batcher(eng, scfg, start=False)
+    prompt = _prompt(6, seed=2)
+    ref = np.asarray(generate(model, params, jnp.asarray(prompt[None]), 6,
+                              temperature=0.8, top_k=5,
+                              rng=jax.random.PRNGKey(3)))[0]
+    req = b.submit(prompt, 6, seed=3)
+    _drive(b, [req])
+    assert req.error is None
+    np.testing.assert_array_equal(ref, np.asarray(req.tokens, np.int32))
+
+
+def test_concurrent_slots_match_batch1_references(lm, greedy_engine):
+    """Three requests with different prompt lengths and budgets through a
+    2-slot continuous batch — every stream equals its own batch-1 generate()
+    (per-row decode positions keep slots independent; early exits reuse
+    slots mid-flight)."""
+    model, params = lm
+    b = Batcher(greedy_engine, greedy_engine.config, start=False)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 97, size=n).astype(np.int32) for n in (3, 11, 6)]
+    news = [9, 4, 7]
+    refs = [np.asarray(generate(model, params, jnp.asarray(p[None]), n))[0]
+            for p, n in zip(prompts, news)]
+    reqs = [b.submit(p, n) for p, n in zip(prompts, news)]
+    _drive(b, reqs)
+    for ref, req in zip(refs, reqs):
+        assert req.error is None
+        np.testing.assert_array_equal(ref, np.asarray(req.tokens, np.int32))
+
+
+def test_jit_cache_is_bounded_by_buckets(lm, greedy_engine):
+    """Admissions at many prompt lengths compile one prefill per BUCKET, not
+    per length — the batcher's churn never compiles."""
+    n_prefill, _ = greedy_engine.compiled_programs()
+    assert n_prefill <= len(greedy_engine.buckets)
+
+
+# ------------------------------------------------------- apply (stateless)
+
+def test_apply_engine_parity_and_padding(lm):
+    """Served stateless outputs == direct apply at batch 1; a 3-request batch
+    pads to 4 and the pad outputs are dropped."""
+    rng = np.random.RandomState(0)
+    W = rng.randn(5, 3).astype(np.float32)
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    params = {"w": W}
+    eng = serving.ApplyEngine(apply_fn, params, ServeConfig(max_batch=4))
+    b = serving.ApplyBatcher(eng, ServeConfig(max_batch=4), start=False)
+    xs = [rng.randn(5).astype(np.float32) for _ in range(3)]
+    reqs = [b.submit(x) for x in xs]
+    _drive(b, reqs)
+    # Bit-identity reference: the SAME jitted program at the padded batch
+    # shape (an eager numpy matmul can differ in the last ulp from XLA's).
+    stacked = np.stack(xs + [xs[-1]], axis=0)           # padded to 4
+    refs = np.asarray(jax.jit(apply_fn)(params, stacked))
+    for i, req in enumerate(reqs):
+        assert req.error is None
+        np.testing.assert_array_equal(refs[i], req.output)
+
+
+# ------------------------------------------------------------ wire loopback
+
+def test_loopback_server_client_end_to_end(lm, greedy_engine):
+    """Concurrent clients against a live continuous-batching server: every
+    stream equals its batch-1 generate() reference, timings are populated,
+    stats and ping work, malformed requests are rejected with typed errors
+    and the connection survives them. Reuses the module engine so this leg
+    adds no compiles beyond its generate() references."""
+    model, params = lm
+    server = serving.InferenceServer(
+        Batcher(greedy_engine, greedy_engine.config))
+    try:
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(1, 97, size=n).astype(np.int32)
+                   for n in (5, 9)]
+        refs = [np.asarray(generate(model, params,
+                                    jnp.asarray(p[None]), 5))[0]
+                for p in prompts]
+        results = [None] * len(prompts)
+
+        def hit(i):
+            c = serving.ServeClient(server.address)
+            try:
+                results[i] = c.generate(prompts[i], 5)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for ref, res in zip(refs, results):
+            assert res is not None, "client thread did not finish"
+            toks, timing = res
+            np.testing.assert_array_equal(ref, toks)
+            assert set(timing) == {"queue_s", "prefill_s", "decode_s",
+                                   "total_s"}
+            assert timing["total_s"] >= 0.0
+
+        c = serving.ServeClient(server.address)
+        try:
+            assert c.ping() < 60.0
+            st = c.stats()
+            assert st["kind"] == "lm" and st["mode"] == "continuous"
+            assert st["registry"]["serve.requests.completed"] >= 3
+            # Malformed requests: typed rejections, connection stays usable.
+            with pytest.raises(ServeError, match="pad bucket"):
+                c.generate(np.arange(100, dtype=np.int32), 5)
+            with pytest.raises(ServeError, match="positive int"):
+                c.generate(prompts[0], 0)
+            with pytest.raises(ServeError, match="'infer' op|LM batcher"):
+                c.infer({"x": np.zeros(3, np.float32)})
+            # A protocol-shaped-but-bogus message gets an error reply, not a
+            # dropped server.
+            reply = c._client.call_raw((123, "nope"), c._client.wire)
+            assert reply[0] == "error" and "malformed" in reply[2]
+            reply = c._client.call_raw(("warp", 1), c._client.wire)
+            assert reply[0] == "error" and "unknown op" in reply[2]
+            # ...and the same connection still serves real requests.
+            toks, _ = c.generate(prompts[0], 5)
+            np.testing.assert_array_equal(refs[0], toks)
+        finally:
+            c.close()
+    finally:
+        server.close()
+
+
+def test_loopback_apply_server(lm):
+    rng = np.random.RandomState(3)
+    W = rng.randn(4, 2).astype(np.float32)
+    params = {"w": W}
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    scfg = ServeConfig(max_batch=4)
+    server = serving.InferenceServer(
+        serving.ApplyBatcher(serving.ApplyEngine(apply_fn, params, scfg),
+                             scfg))
+    try:
+        c = serving.ServeClient(server.address)
+        try:
+            x = rng.randn(4).astype(np.float32)
+            out, timing = c.infer(x)
+            # Same jitted program, same batch shape -> bit-identical.
+            ref = np.asarray(jax.jit(apply_fn)(params, x[None]))[0]
+            np.testing.assert_array_equal(ref, out)
+            with pytest.raises(ServeError, match="'generate' op|apply"):
+                c.generate(np.arange(3, dtype=np.int32), 2)
+            assert c.stats()["kind"] == "apply"
+        finally:
+            c.close()
+    finally:
+        server.close()
+
+
+# ------------------------------------------------------------- SLO metrics
+
+def test_slo_metric_families_in_snapshot(lm, greedy_engine):
+    """The serve.* families land in the process-global telemetry snapshot,
+    and the latency histograms carry the ms-scale family buckets (not the
+    step-time defaults) so a loopback distribution actually resolves."""
+    b = Batcher(greedy_engine, greedy_engine.config, start=False)
+    req = b.submit(_prompt(4, seed=5), 2)
+    _drive(b, [req])
+    snap = telemetry.snapshot()
+    for fam in ("queue", "prefill", "decode", "total"):
+        h = snap[f"serve.latency_s.{fam}"]
+        assert h["count"] >= 1
+        from autodist_tpu.telemetry import metrics as tmetrics
+        for edge in tmetrics.MS_BUCKETS:
+            assert f"le:{edge:g}" in h
+    for name in ("serve.requests.submitted", "serve.requests.completed",
+                 "serve.requests.rejected", "serve.queue_depth",
+                 "serve.batch_fill"):
+        assert name in snap

@@ -393,6 +393,77 @@ def test_gl006_flags_asymmetric_codec_tags_and_unchecked_version(tmp_path):
     assert "b'z'" in msgs and "b'y'" in msgs and "sloppy_len" in msgs
 
 
+def test_gl006_flags_serving_op_without_dispatch_arm(tmp_path):
+    """Serving-transport shape: the dispatcher is a server-class METHOD and
+    several server classes may share the module — a client op must match an
+    arm in ANY of them, and a missing arm is flagged (the PR 7 serving wire
+    gets the same exhaustiveness guarantee as the PS wire)."""
+    res = lint(tmp_path, """
+        class InferenceServer:
+            def _dispatch(self, msg):
+                op = msg[0]
+                if op == "generate":
+                    return ("ok",)
+                if op == "stats":
+                    return ("ok", {})
+                return ("error", "ServeError", "unknown")
+
+        class AdminServer:
+            def _dispatch(self, msg):
+                op = msg[0]
+                if op == "drain":
+                    return ("ok",)
+                return ("error", "ServeError", "unknown")
+
+        class ServeClient:
+            def generate(self, prompt):
+                return self._client.call("generate", prompt)
+
+            def infer(self, example):
+                return self._client.call("infer", example)
+
+            def drain(self):
+                return self._client.call("drain")
+    """, checks=["GL006"])
+    assert codes(res) == ["GL006"]
+    # 'generate' and 'drain' resolve across the two dispatchers; only the
+    # armless 'infer' is a finding.
+    assert "'infer'" in res.findings[0].message
+
+
+def test_gl006_clean_serving_protocol(tmp_path):
+    """The real serving vocabulary (generate/infer/stats/ping), method-style
+    dispatcher, every op armed — clean."""
+    res = lint(tmp_path, """
+        class InferenceServer:
+            def _dispatch(self, msg):
+                op = msg[0]
+                if op == "generate":
+                    return ("ok",)
+                if op == "infer":
+                    return ("ok",)
+                if op == "stats":
+                    return ("ok", {})
+                if op == "ping":
+                    return ("ok", None)
+                return ("error", "ServeError", "unknown")
+
+        class ServeClient:
+            def generate(self, prompt):
+                return self._client.call("generate", prompt)
+
+            def infer(self, example):
+                return self._client.call("infer", example)
+
+            def stats(self):
+                return self._client.call("stats")[0]
+
+            def ping(self):
+                return self._client.call("ping")
+    """, checks=["GL006"])
+    assert res.ok
+
+
 def test_gl006_clean_symmetric_protocol(tmp_path):
     res = lint(tmp_path, """
         class Client:

@@ -184,6 +184,36 @@ def test_registry_snapshot_deterministic():
     assert wire.decode(wire.encode(r1.snapshot())) == r1.snapshot()
 
 
+def test_histogram_family_bucket_overrides():
+    """Per-family default-bucket resolution (PR 7): names under a
+    BUCKET_FAMILIES prefix get that family's edges (serve latencies resolve
+    at ms scale), longest prefix wins, explicit buckets always override, and
+    names outside every family keep the pre-existing SECONDS_BUCKETS default
+    — the snapshot schema of old histograms is unchanged."""
+    assert tmetrics.family_buckets("serve.latency_s") == tmetrics.MS_BUCKETS
+    assert tmetrics.family_buckets("serve.latency_s.total") \
+        == tmetrics.MS_BUCKETS
+    # Prefix match is component-wise: a sibling name is NOT in the family.
+    assert tmetrics.family_buckets("serve.latency_sx") \
+        == tmetrics.SECONDS_BUCKETS
+    assert tmetrics.family_buckets("train.step_s") == tmetrics.SECONDS_BUCKETS
+
+    reg = tmetrics.Registry()
+    ms = reg.histogram("serve.latency_s.queue")
+    assert ms.buckets == tmetrics.MS_BUCKETS
+    old = reg.histogram("train.step_s")
+    assert old.buckets == tmetrics.SECONDS_BUCKETS
+    explicit = reg.histogram("serve.latency_s.custom", buckets=(1, 2))
+    assert explicit.buckets == (1, 2)
+    # Snapshot schema: the family's edges appear as le: keys, same shape as
+    # every other histogram.
+    ms.observe(0.003)
+    snap = reg.snapshot()["serve.latency_s.queue"]
+    assert set(snap) == {f"le:{b:g}" for b in tmetrics.MS_BUCKETS} \
+        | {"le:+inf", "count", "sum"}
+    assert snap["le:0.005"] == 1
+
+
 def test_registry_get_or_create_and_type_guard():
     reg = tmetrics.Registry()
     assert reg.counter("c") is reg.counter("c")
