@@ -172,7 +172,8 @@ class AutoDist:
                                    num_workers: Optional[int] = None,
                                    accumulation_steps: int = 1,
                                    batch_size: Optional[int] = None,
-                                   zero: Optional[Any] = None) -> DistributedRunner:
+                                   zero: Optional[Any] = None,
+                                   health: Optional[bool] = None) -> DistributedRunner:
         """Compile the strategy for this model and return the runner
         (reference autodist.py:191-198 returned the wrapped session).
 
@@ -193,6 +194,12 @@ class AutoDist:
         shard-local update -> all-gather); the async regime shards the chief's
         server-side apply over N concurrent param shards (``zero=N``). See
         docs/usage/performance.md "Weight-update sharding (ZeRO)".
+
+        ``health`` enables the training-health monitors (default: the
+        ``AUTODIST_HEALTH`` flag) on the synchronous runner: the jitted step
+        additionally emits the fused numerics bundle ``train()``'s monitors
+        consume at log boundaries. See docs/usage/observability.md
+        "Training health monitors".
         """
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
         # Builders that model memory (AutoStrategy) get the session's optimizer
@@ -235,7 +242,8 @@ class AutoDist:
         return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
                                  has_aux=has_aux, plan=plan,
                                  accumulation_steps=accumulation_steps,
-                                 batch_size=batch_size, zero=zero)
+                                 batch_size=batch_size, zero=zero,
+                                 health=health)
 
     def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
         if sparse_names is not None:
@@ -249,7 +257,8 @@ class AutoDist:
                  example_batch: Any = None, sparse_names: Optional[Sequence[str]] = None,
                  has_aux: bool = False, accumulation_steps: int = 1,
                  batch_size: Optional[int] = None,
-                 zero: Optional[Any] = None) -> Callable:
+                 zero: Optional[Any] = None,
+                 health: Optional[bool] = None) -> Callable:
         """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
         internally (reference autodist.py:252-289 cached a built runner the same
         way: first call builds, later calls reuse).
@@ -261,7 +270,7 @@ class AutoDist:
         runner = self.create_distributed_session(
             loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
             accumulation_steps=accumulation_steps, batch_size=batch_size,
-            zero=zero)
+            zero=zero, health=health)
         state = runner.init(params)
 
         def step(batch, fetches=None):

@@ -80,6 +80,24 @@ KNOWN_FLAGS = {
                             "requests are rejected, not parked",
     "AUTODIST_SERVE_TIMEOUT_S": "server-side cap (seconds) on one serving "
                                 "request's completion wait",
+    "AUTODIST_HEALTH": "training-health monitors: per-step on-device "
+                       "numerics bundle (grad norm, update/param ratio, "
+                       "NaN/Inf) + host-side loss-spike detection",
+    "AUTODIST_HEALTH_ACTION": "what a health anomaly does: 'warn' (log), "
+                              "'record' (flight-recorder snapshot), 'halt' "
+                              "(raise HealthHalt with the state attached)",
+    "AUTODIST_HEALTH_ZMAX": "loss-spike EWMA z-score threshold (a boundary "
+                            "loss this many sigmas above the running mean "
+                            "is an anomaly)",
+    "AUTODIST_RECORDER": "flight recorder: anomalies (watchdog + health) "
+                         "auto-capture trace/metrics/events snapshots",
+    "AUTODIST_RECORDER_DIR": "flight-recorder snapshot root (default "
+                             "<AUTODIST_WORKING_DIR>/flightrec)",
+    "AUTODIST_RECORDER_KEEP": "flight-recorder ring size: latest-K snapshot "
+                              "dirs kept, older ones evicted",
+    "AUTODIST_RECORDER_MIN_S": "min seconds between automatic snapshots "
+                               "(an anomaly storm must not write one per "
+                               "step); manual `record` requests bypass it",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -168,6 +186,22 @@ _ENV_DEFAULTS = {
     "AUTODIST_SERVE_MODE": "continuous",
     "AUTODIST_SERVE_QUEUE": 256,
     "AUTODIST_SERVE_TIMEOUT_S": 120.0,
+    # Training-health plane (autodist_tpu/telemetry/health.py): per-step
+    # on-device numerics bundle + host-side loss-spike detection, and the
+    # policy an anomaly triggers. Off by default — the step body stays
+    # byte-identical to the unmonitored program.
+    "AUTODIST_HEALTH": False,
+    "AUTODIST_HEALTH_ACTION": "warn",
+    "AUTODIST_HEALTH_ZMAX": 6.0,
+    # Flight recorder (autodist_tpu/telemetry/recorder.py): bounded
+    # latest-K ring of self-contained anomaly snapshot dirs (merged cluster
+    # trace + metrics/events + env manifest). AUTODIST_RECORDER=1 arms the
+    # automatic triggers (watchdog + health anomalies); the `record` wire
+    # opcode and FlightRecorder.record() work either way.
+    "AUTODIST_RECORDER": False,
+    "AUTODIST_RECORDER_DIR": "",
+    "AUTODIST_RECORDER_KEEP": 8,
+    "AUTODIST_RECORDER_MIN_S": 30.0,
 }
 
 class ENV(enum.Enum):
@@ -203,6 +237,13 @@ class ENV(enum.Enum):
     AUTODIST_SERVE_MODE = "AUTODIST_SERVE_MODE"
     AUTODIST_SERVE_QUEUE = "AUTODIST_SERVE_QUEUE"
     AUTODIST_SERVE_TIMEOUT_S = "AUTODIST_SERVE_TIMEOUT_S"
+    AUTODIST_HEALTH = "AUTODIST_HEALTH"
+    AUTODIST_HEALTH_ACTION = "AUTODIST_HEALTH_ACTION"
+    AUTODIST_HEALTH_ZMAX = "AUTODIST_HEALTH_ZMAX"
+    AUTODIST_RECORDER = "AUTODIST_RECORDER"
+    AUTODIST_RECORDER_DIR = "AUTODIST_RECORDER_DIR"
+    AUTODIST_RECORDER_KEEP = "AUTODIST_RECORDER_KEEP"
+    AUTODIST_RECORDER_MIN_S = "AUTODIST_RECORDER_MIN_S"
 
     @property
     def val(self):

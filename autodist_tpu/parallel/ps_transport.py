@@ -448,6 +448,11 @@ class _StragglerWatchdog:
             reg.counter("ps.straggler.flags").inc()
             reg.event(f"ps.anomaly.{kind}", worker=wid,
                       last_seen_s=round(age, 3))
+            # Flight recorder: an armed recorder (AUTODIST_RECORDER=1 or
+            # telemetry.set_recorder) snapshots the cluster trace + metrics
+            # at the anomaly, debounced; un-armed it is a no-op.
+            from autodist_tpu.telemetry import recorder as _recorder
+            _recorder.maybe_record(f"ps.{kind}.w{wid}", server=self._server)
             if now - self._last_warn.get(wid, -math.inf) >= self._warn_every:
                 self._last_warn[wid] = now
                 if kind == "stall":
@@ -671,6 +676,30 @@ class PSServer:
             snap["shard_versions"] = list(shard_versions)
         return snap
 
+    def status_snapshot(self) -> dict:
+        """The live-ops view the ``status`` opcode ships (``tools/adtop.py``
+        polls it): :meth:`stats_snapshot` plus the gate's INSTANTANEOUS
+        per-worker lags and bound, the recent structured events, and a
+        ``kind`` discriminator so one console renders PS and serving
+        endpoints alike."""
+        snap = self.stats_snapshot()
+        snap["kind"] = "ps"
+        # Rename, don't alias: `status` replies ship the bounded event ring
+        # ONCE (adtop reads `events`, falling back to the stats plane's
+        # `anomalies` key) — an aliased copy doubles the poll payload.
+        snap["events"] = snap.pop("anomalies", [])
+        controller = getattr(self._runner, "controller", None)
+        if controller is not None:
+            bound = controller.bound
+            snap["staleness_bound"] = None if math.isinf(bound) else int(bound)
+            for wid, lag in controller.live_lags().items():
+                snap["per_worker"].setdefault(wid, {})["lag"] = int(lag)
+        service = getattr(self._runner, "service", None)
+        version = getattr(service, "version", None)
+        if version is not None:
+            snap["version"] = int(version)
+        return snap
+
     def _store_worker_trace(self, worker_id, state):
         """The ``push_trace`` arm's sink: keep a worker's deposited span ring
         (latest wins) for :func:`telemetry.collect_cluster_trace`.
@@ -762,6 +791,18 @@ class PSServer:
                 # snapshot + per-worker wire/staleness breakdown to whoever
                 # asks (RemotePSWorker.stats(), dashboards, tests).
                 return ("ok", self.stats_snapshot())
+            if op == "status":
+                # Live-ops console plane (tools/adtop.py): stats plus the
+                # gate's instantaneous lags/bound and recent anomaly events.
+                return ("ok", self.status_snapshot())
+            if op == "record":
+                # Manual flight-recorder trigger: capture a snapshot NOW
+                # (bypasses the debounce — a human asked) and return its
+                # path. Arms a default recorder when none is installed.
+                from autodist_tpu.telemetry import recorder as _recorder
+                reason = str(msg[1]) if len(msg) > 1 and msg[1] else "manual"
+                path = _recorder.get_or_create().record(reason, server=self)
+                return ("ok", path)
             if op == "ping":
                 # Clock-offset probe: echo the client's send stamp with this
                 # process's wall clock. No locks, no device work — the reply
@@ -1126,6 +1167,18 @@ class RemotePSWorker:
         (:meth:`PSServer.stats_snapshot`) — remote observability without
         grepping the chief's log."""
         return self._client.call("stats")[0]
+
+    def status(self) -> dict:
+        """Pull the chief's live-ops status (:meth:`PSServer.status_snapshot`
+        — stats plus instantaneous gate lags and recent anomaly events); the
+        payload ``tools/adtop.py`` renders."""
+        return self._client.call("status")[0]
+
+    def record(self, reason: str = "manual") -> Optional[str]:
+        """Trigger a flight-recorder snapshot ON THE CHIEF (the ``record``
+        opcode; bypasses the debounce) and return the chief-side snapshot
+        dir path — the remote 'capture the cluster's state now' button."""
+        return self._client.call("record", reason)[0]
 
     def estimate_clock_offset(self, rounds: Optional[int] = None):
         """Estimate the chief-clock offset for this worker: ``rounds`` ping

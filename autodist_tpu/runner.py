@@ -161,9 +161,22 @@ class DistributedRunner:
                  optimizer, mesh: Optional[Mesh] = None, has_aux: bool = False,
                  donate_state: bool = True, plan: Optional[ShardingPlan] = None,
                  accumulation_steps: int = 1, batch_size: Optional[int] = None,
-                 zero: Optional[Any] = None):
+                 zero: Optional[Any] = None, health: Optional[bool] = None):
         if accumulation_steps < 1:
             raise ValueError("accumulation_steps must be >= 1")
+        # Training-health monitors (``health=None`` reads AUTODIST_HEALTH):
+        # when on, the step body additionally computes the fused numerics
+        # bundle (telemetry/health.py) — four f32 scalars in the SAME
+        # compiled program, read back only at the train loop's log
+        # boundaries. Off (the default) leaves the program byte-identical.
+        if health is None:
+            from autodist_tpu import const
+            health = const.ENV.AUTODIST_HEALTH.val
+        self.health = bool(health)
+        # The most recent step's device-side health bundle (float32[4] per
+        # telemetry.health.BUNDLE_FIELDS; an unroll block arrives reduced).
+        # A device array — callers device_get it at their own sync points.
+        self.last_health = None
         # ZeRO-style weight-update sharding (arXiv 2004.13336; ``zero=None``
         # reads AUTODIST_ZERO): 0/False off, 1/True on, N>1 on with N
         # server-side PS apply shards (the async regime's knob). On the
@@ -264,9 +277,12 @@ class DistributedRunner:
     # -------------------------------------------------------------------- step
     def _make_step_body(self, fetch_fn: Optional[Callable] = None):
         """The pure (untraced) one-step function ``(state, batch) -> (state,
-        (loss, aux, fetched))``. Single source of the step math: ``_build_step``
-        jits it directly and ``_build_many`` scans it — so the fused multi-step
-        path can never drift numerically from the per-step path."""
+        (loss, aux, fetched, bundle))`` — ``bundle`` is the fused health
+        numerics float32[4] when monitors are on, an empty tuple (nothing in
+        the compiled program) when off. Single source of the step math:
+        ``_build_step`` jits it directly and ``_build_many`` scans it — so the
+        fused multi-step path can never drift numerically from the per-step
+        path."""
         import jax.numpy as jnp
 
         optimizer = self._optimizer
@@ -276,6 +292,10 @@ class DistributedRunner:
         # as (plan, mesh) statics so the body stays a pure function of state.
         zero_plan = self.plan if self.plan.zero else None
         mesh = self.mesh
+        # Health bundle: a TRACE-TIME static — the disabled program carries
+        # nothing (an empty tuple output), the enabled one a few fused
+        # reductions over intermediates the step already has.
+        health_on = self.health
 
         def accumulate(params, batch, ef_state):
             """Gradient accumulation: scan grad_fn over the micro axis, summing
@@ -354,7 +374,15 @@ class DistributedRunner:
                 fetched = fetch_fn(state.params, logical)
             else:
                 fetched = ()
-            return new_state, (loss, aux, fetched)
+            if health_on:
+                from autodist_tpu.telemetry import health as _health
+                # Pre-update params: the ratio convention is update magnitude
+                # relative to the weights it applies to.
+                bundle = _health.device_bundle(grads, updates, state.params,
+                                               loss)
+            else:
+                bundle = ()   # empty pytree: nothing in the compiled program
+            return new_state, (loss, aux, fetched, bundle)
 
         return step_fn
 
@@ -387,9 +415,18 @@ class DistributedRunner:
         retraces per scan length, so varying block sizes (cadence-clipped tail
         blocks) reuse their own executables."""
         step_fn = self._make_step_body(fetch_fn)
+        health_on = self.health
 
         def many_fn(state: TrainState, block: PyTree):
-            return jax.lax.scan(step_fn, state, block)
+            state, (losses, auxes, fetched, bundles) = jax.lax.scan(
+                step_fn, state, block)
+            if health_on:
+                # Reduce the [K, 4] per-step bundles ON DEVICE (nonfinite
+                # sums, norms max) — a K-step block still reads back four
+                # scalars at the log boundary.
+                from autodist_tpu.telemetry import health as _health
+                bundles = _health.reduce_bundle(bundles)
+            return state, (losses, auxes, fetched, bundles)
 
         donate = (0,) if self._donate else ()
         jitted = jax.jit(
@@ -704,7 +741,10 @@ class DistributedRunner:
         with self._dispatch_span("runner.run.dispatch", "step", fetches,
                                  sharded):
             with self.mesh:
-                new_state, (loss, aux, fetched) = step_fn(state, sharded)
+                new_state, (loss, aux, fetched, bundle) = step_fn(state,
+                                                                  sharded)
+        if self.health:
+            self.last_health = bundle
         default = (loss, aux) if self._has_aux else loss
         if fetches is not None:
             return new_state, (default, fetched)
@@ -742,7 +782,10 @@ class DistributedRunner:
         with self._dispatch_span("runner.run_many.dispatch", "many", fetches,
                                  block.tree, steps=block.length):
             with self.mesh:
-                new_state, (losses, auxes, fetched) = many_fn(state, block.tree)
+                new_state, (losses, auxes, fetched, bundle) = many_fn(
+                    state, block.tree)
+        if self.health:
+            self.last_health = bundle
         default = (losses, auxes) if self._has_aux else losses
         if fetches is not None:
             return new_state, (default, fetched)

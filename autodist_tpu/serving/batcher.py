@@ -168,21 +168,32 @@ class ServeRequest:
         return self.abandoned or (self.deadline and now > self.deadline)
 
     def timing(self) -> dict:
-        """Wire-encodable latency breakdown (seconds), shipped in the reply
-        so clients see where a slow request spent its time."""
-        return {"queue_s": round(self.t_admit - self.t_submit, 6),
+        """Wire-encodable latency breakdown (seconds) plus the server-side
+        correlation id, shipped in the reply — ``request_id`` is what ties a
+        client-observed latency to the server's spans (``serve.prefill``/
+        ``serve.decode_step`` carry the same id in their args) and to the
+        ``status`` opcode's in-flight table (``tools/adtop.py``)."""
+        return {"request_id": self.rid,
+                "queue_s": round(self.t_admit - self.t_submit, 6),
                 "prefill_s": round(self.t_prefill_done - self.t_admit, 6),
                 "decode_s": round(self.t_done - self.t_prefill_done, 6),
                 "total_s": round(self.t_done - self.t_submit, 6)}
 
     def finish(self, error: Optional[str] = None):
+        self.stamp_done(error)
+        self.done.set()
+
+    def stamp_done(self, error: Optional[str] = None):
+        """Set the completion timestamps WITHOUT signalling the waiter —
+        the batcher books its SLO counters between stamping and the
+        ``done.set()``, so a client whose reply arrived can never read a
+        ``stats``/``status`` snapshot that misses its own request."""
         self.t_done = time.perf_counter()
         if not self.t_admit:          # rejected/failed before admission
             self.t_admit = self.t_prefill_done = self.t_done
         if not self.t_prefill_done:
             self.t_prefill_done = self.t_done
         self.error = error
-        self.done.set()
 
 
 class _ServeMetrics:
@@ -268,6 +279,13 @@ class _BatcherBase:
     def _inflight_locked(self) -> List[ServeRequest]:
         """Hook (called under ``_lock`` from :meth:`close`): active requests
         to fail at shutdown; implementations must also detach them."""
+        return []
+
+    def in_flight_snapshot(self) -> List[dict]:
+        """Wire-encodable per-request view of what is on the device right
+        now (the ``status`` opcode's in-flight table): empty for batchers
+        whose requests are transient (the apply path runs whole waves inside
+        one ``run_once``)."""
         return []
 
     def close(self):
@@ -369,6 +387,24 @@ class Batcher(_BatcherBase):
         with self._lock:
             return sum(r is not None for r in self._slots)
 
+    def in_flight_snapshot(self) -> List[dict]:
+        """One dict per occupied decode slot: request id, slot, seconds in
+        the system, tokens generated so far, prompt length — what an
+        operator needs to spot the request a batch is convoyed behind."""
+        now = time.perf_counter()
+        with self._lock:
+            slots = list(enumerate(self._slots))
+        out = []
+        for slot, req in slots:
+            if req is None:
+                continue
+            out.append({"request_id": req.rid, "slot": slot,
+                        "age_s": round(now - req.t_submit, 3),
+                        "tokens": len(req.tokens),
+                        "prompt_len": int(req.prompt.size),
+                        "max_new_tokens": int(req.max_new_tokens)})
+        return out
+
     def run_once(self) -> bool:
         """One scheduling round: admit what the mode allows, then one decode
         step for the active batch. Returns False when there was nothing to
@@ -391,7 +427,13 @@ class Batcher(_BatcherBase):
         if not active:
             return False
         keys = self._step_keys(active, n_slots)
-        with telemetry.span("serve.decode_step", active=len(active)):
+        # The rids join is per-TOKEN work in the scheduler thread: build it
+        # only when the span will actually record it (disabled-mode serving
+        # must stay at the one-attribute-check contract).
+        rids = ",".join(str(r.rid) for _, r in active) \
+            if telemetry.enabled() else ""
+        with telemetry.span("serve.decode_step", active=len(active),
+                            rids=rids):
             toks = self._engine.step(keys)
         for slot, req in active:
             tok = int(toks[slot])
@@ -442,7 +484,7 @@ class Batcher(_BatcherBase):
             # requests may cost device work.
             req.keys = self._engine.make_keys(req.seed, req.max_new_tokens)
             try:
-                with telemetry.span("serve.prefill", slot=slot,
+                with telemetry.span("serve.prefill", slot=slot, rid=req.rid,
                                     prompt_len=int(req.prompt.size)):
                     first = self._engine.admit(
                         slot, req.prompt,
@@ -471,9 +513,10 @@ class Batcher(_BatcherBase):
         """Early exit: the finished request leaves the batch NOW, freeing its
         KV-cache slot for the next waiter."""
         self._release(slot)
-        req.finish()
+        req.stamp_done()
         self._metrics.completed.inc()
         self._metrics.observe(req)
+        req.done.set()
 
 
 class ApplyBatcher(_BatcherBase):
@@ -522,7 +565,8 @@ class ApplyBatcher(_BatcherBase):
             return True
         for req, out in zip(batch, outs):
             req.output = out
-            req.finish()
+            req.stamp_done()
             self._metrics.completed.inc()
             self._metrics.observe(req)
+            req.done.set()
         return True

@@ -89,8 +89,13 @@ class InferenceServer:
                                            counters=outer.wire)
                         is_protocol = isinstance(msg, tuple) and bool(msg)
                         op = msg[0] if is_protocol else "<malformed>"
-                        with telemetry.span("serve.request", op=str(op)):
-                            reply = outer._dispatch(msg)
+                        with telemetry.span("serve.request",
+                                            op=str(op)) as sp:
+                            # The dispatch stamps the request id it assigns
+                            # onto this span (sp.set(rid=...)) so one id ties
+                            # the transport span, the batcher's prefill/
+                            # decode spans, and the reply timing together.
+                            reply = outer._dispatch(msg, sp)
                         try:
                             payload = wire.encode_parts(reply)
                         except wire.WireError as e:
@@ -133,14 +138,29 @@ class InferenceServer:
 
     def stats_snapshot(self) -> dict:
         """Wire-encodable serving snapshot: the telemetry registry (the
-        ``serve.*`` SLO families live there), queue/batch state, uptime."""
+        ``serve.*`` SLO families live there), queue/batch state, uptime,
+        and the structured event ring (so anomaly records survive the
+        serving process — the stats plane is their offline exit)."""
         return {"registry": telemetry.snapshot(),
                 "wire": self.wire.snapshot(),
                 "uptime_s": round(time.monotonic() - self._t_started, 3),
                 "mode": self._batcher.config.mode,
                 "kind": self._batcher.kind,
                 "capacity": self._batcher._engine.capacity,
-                "queue_depth": self._batcher.queue_depth()}
+                "queue_depth": self._batcher.queue_depth(),
+                "events": telemetry.events()}
+
+    def status_snapshot(self) -> dict:
+        """The live-ops view the ``status`` opcode ships (``tools/adtop.py``
+        polls it): :meth:`stats_snapshot` plus the per-request IN-FLIGHT
+        table (request id, slot, age, tokens decoded) and a ``kind``
+        discriminator (``serve``) so one console renders PS and serving
+        endpoints alike."""
+        snap = self.stats_snapshot()
+        snap["kind"] = "serve"
+        snap["engine"] = self._batcher.kind
+        snap["in_flight"] = self._batcher.in_flight_snapshot()
+        return snap
 
     def _wait(self, req, timeout) -> tuple:
         """Park this handler thread (bounded) until the batcher completes the
@@ -161,7 +181,7 @@ class InferenceServer:
             return ("ok", np.asarray(req.tokens, np.int32), req.timing())
         return ("ok", req.output, req.timing())
 
-    def _dispatch(self, msg):
+    def _dispatch(self, msg, sp=None):
         # A peer can legally encode a bare dict/int/None; reject it as a
         # protocol error instead of raising outside the per-op try.
         if not isinstance(msg, tuple) or not msg \
@@ -177,6 +197,8 @@ class InferenceServer:
                                      "batcher; use the 'infer' op")
                 _, prompt, max_new, seed, timeout = msg
                 req = self._batcher.submit(prompt, max_new, seed=int(seed))
+                if sp is not None:
+                    sp.set(rid=req.rid)
                 return self._wait(req, timeout)
             if op == "infer":
                 if self._batcher.kind != "apply":
@@ -184,9 +206,15 @@ class InferenceServer:
                                      "the 'generate' op")
                 _, example, timeout = msg
                 req = self._batcher.submit(example)
+                if sp is not None:
+                    sp.set(rid=req.rid)
                 return self._wait(req, timeout)
             if op == "stats":
                 return ("ok", self.stats_snapshot())
+            if op == "status":
+                # Live-ops console plane (tools/adtop.py): stats plus the
+                # in-flight request table.
+                return ("ok", self.status_snapshot())
             if op == "ping":
                 return ("ok", msg[1] if len(msg) > 1 else None,
                         time.time_ns())
@@ -245,6 +273,12 @@ class ServeClient:
 
     def stats(self) -> dict:
         return self._client.call("stats")[0]
+
+    def status(self) -> dict:
+        """The server's live-ops status (:meth:`InferenceServer.
+        status_snapshot`): SLO registry + queue depth + in-flight request
+        ids — what ``tools/adtop.py`` renders."""
+        return self._client.call("status")[0]
 
     def ping(self) -> float:
         """Round-trip seconds to the server (health check)."""

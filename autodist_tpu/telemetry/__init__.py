@@ -19,14 +19,29 @@ Three planes, one subsystem (docs/usage/observability.md):
   into ONE clock-aligned Chrome trace with a ``pid`` lane per worker;
   ``tools/tracedump.py`` does the same offline from JSONL ring dumps.
 
+- **Training-health plane** (:mod:`autodist_tpu.telemetry.health`) —
+  ``AUTODIST_HEALTH=1`` adds a fused on-device numerics bundle (grad norm,
+  update/param ratio, NaN/Inf count) to the existing jitted step plus a
+  host-side loss-spike monitor at log boundaries; anomalies become
+  ``health.anomaly`` events and the ``AUTODIST_HEALTH_ACTION`` policy
+  (warn / record / halt) decides the reaction.
+- **Flight recorder** (:mod:`autodist_tpu.telemetry.recorder`) — anomaly
+  events (watchdog, health, the manual ``record`` wire opcode) capture
+  self-contained snapshot dirs (merged cluster trace + metrics/events +
+  env manifest) into a bounded latest-K ring; ``tools/adtop.py`` is the
+  live console over the ``status`` opcode.
+
 Everything is OFF by default; ``AUTODIST_TELEMETRY=1`` (or
 :func:`telemetry.enable`) turns recording on. Disabled-mode instrumentation
 costs one attribute check per span (gated in ``bench.py
---telemetry-overhead``).
+--telemetry-overhead``); disabled health monitors cost one attribute check
+per train step (``bench.py --health-overhead`` gates the enabled side).
 """
 
 from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
+                                            dump_events_jsonl,
                                             dump_spans_jsonl,
+                                            load_events_jsonl,
                                             load_trace_jsonl,
                                             local_trace_state,
                                             merge_trace_states, ntp_offset)
@@ -34,10 +49,14 @@ from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
                                            export_chrome_trace,
                                            opt_state_bytes,
                                            sample_device_memory)
+from autodist_tpu.telemetry.health import (HealthConfig, HealthHalt,
+                                           HealthMonitor)
 from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                             Registry, counter, event, events,
                                             gauge, histogram, registry,
                                             snapshot)
+from autodist_tpu.telemetry.recorder import (FlightRecorder, get_recorder,
+                                             maybe_record, set_recorder)
 from autodist_tpu.telemetry.spans import (clear, disable, enable, enabled,
                                           snapshot_spans, span, traced)
 
@@ -51,4 +70,7 @@ __all__ = [
     "sample_device_memory", "opt_state_bytes",
     "collect_cluster_trace", "local_trace_state", "merge_trace_states",
     "dump_spans_jsonl", "load_trace_jsonl", "ntp_offset",
+    "dump_events_jsonl", "load_events_jsonl",
+    "HealthConfig", "HealthHalt", "HealthMonitor",
+    "FlightRecorder", "set_recorder", "get_recorder", "maybe_record",
 ]

@@ -46,7 +46,7 @@ from autodist_tpu.utils import logging
 
 __all__ = ["local_trace_state", "ntp_offset", "trace_state_events",
            "merge_trace_states", "collect_cluster_trace", "dump_spans_jsonl",
-           "load_trace_jsonl"]
+           "load_trace_jsonl", "dump_events_jsonl", "load_events_jsonl"]
 
 # Trace-blob schema version (bumped on layout changes so an old tracedump
 # rejects a new dump instead of misreading it).
@@ -202,14 +202,43 @@ def _assign_pid(state: Dict[str, Any], used: set) -> int:
     return pid
 
 
-def merge_trace_states(states: Iterable[Dict[str, Any]],
-                       path: str) -> str:
+def instant_trace_events(records: Iterable[Dict[str, Any]], pid: int,
+                         origin_ns: int) -> List[Dict[str, Any]]:
+    """Registry event records (``telemetry.events()`` /
+    :func:`load_events_jsonl`) as Chrome INSTANT events on lane ``pid``:
+    a process_name metadata event plus one ``"i"`` (global-scope) marker per
+    record, placed by its ``t_wall_s`` wall stamp relative to ``origin_ns``
+    — so anomalies appear as vertical markers over the span timeline."""
+    out: List[Dict[str, Any]] = []
+    markers = []
+    for rec in records:
+        rec = dict(rec)
+        name = str(rec.pop("name", "event"))
+        t_wall_s = rec.pop("t_wall_s", None)
+        if t_wall_s is None:
+            continue
+        markers.append({
+            "name": name, "ph": "i", "s": "g", "cat": "anomaly",
+            "ts": (float(t_wall_s) * 1e9 - origin_ns) / 1e3,
+            "pid": pid, "tid": 0,
+            "args": _sanitize_args(rec) or {},
+        })
+    if markers:
+        out.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                    "args": {"name": "events (anomalies)"}})
+        out.extend(markers)
+    return out
+
+
+def merge_trace_states(states: Iterable[Dict[str, Any]], path: str,
+                       instant_events: Iterable[Dict[str, Any]] = ()) -> str:
     """Merge trace blobs into ONE Chrome trace file at ``path``.
 
     Every blob's spans are rebased onto the chief wall clock
     (``wall + clock_offset_ns``); the merged origin is the earliest rebased
     span start across all lanes, so the file opens at t=0 in Perfetto.
-    Returns ``path``."""
+    ``instant_events`` (registry event records — anomalies) overlay the
+    timeline as instant markers on their own lane. Returns ``path``."""
     states = list(states)
     for st in states:
         v = st.get("v", TRACE_STATE_VERSION)
@@ -218,11 +247,22 @@ def merge_trace_states(states: Iterable[Dict[str, Any]],
                              f"(this build reads v{TRACE_STATE_VERSION})")
     origins = [int(_wall_starts(st).min()) for st in states
                if len(np.asarray(st["t0_ns"])) > 0]
+    instant_events = list(instant_events)
+    if not origins and instant_events:
+        # Every ring is empty (recording off — an armed recorder without
+        # AUTODIST_TELEMETRY still snapshots): anchor the timeline on the
+        # earliest event so markers sit near t=0, not at epoch scale.
+        stamps = [float(r["t_wall_s"]) for r in instant_events
+                  if r.get("t_wall_s") is not None]
+        origins = [int(min(stamps) * 1e9)] if stamps else []
     origin_ns = min(origins) if origins else 0
     events: List[Dict[str, Any]] = []
     used: set = set()
     for st in states:
         events.extend(trace_state_events(st, _assign_pid(st, used), origin_ns))
+    if instant_events:
+        pid = max(used) + 1 if used else 0
+        events.extend(instant_trace_events(instant_events, pid, origin_ns))
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(doc, f)
@@ -344,3 +384,36 @@ def load_trace_jsonl(path: str,
     state["thread_names"] = {int(t): nm for t, nm in
                              dict(meta.get("thread_names", {})).items()}
     return state
+
+
+def dump_events_jsonl(path: str, events=None) -> str:
+    """Dump structured registry events (``telemetry.events()``) as JSONL —
+    one record per line — so anomaly records survive process exit. The event
+    ring is in-process and drain-only otherwise; this is its offline leg
+    (the flight recorder writes one per snapshot, ``tools/tracedump.py
+    --events`` merges the file back into a timeline as instant markers).
+    ``events`` defaults to the process registry's current ring."""
+    from autodist_tpu.telemetry import metrics as _metrics
+    if events is None:
+        events = _metrics.events()
+    with open(path, "w") as f:
+        for rec in events:
+            f.write(json.dumps(rec, default=str) + "\n")
+    return path
+
+
+def load_events_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Load a :func:`dump_events_jsonl` file back into event records,
+    oldest first (each line must be one JSON object with at least a
+    ``name``)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "name" not in rec:
+                raise ValueError(f"{path}:{i + 1}: not an event record "
+                                 f"(expected a JSON object with 'name')")
+            out.append(rec)
+    return out

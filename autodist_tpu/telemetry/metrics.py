@@ -36,6 +36,11 @@ COUNT_BUCKETS = (0, 1, 2, 4, 8, 16, 32)
 # where a whole loopback serving distribution lives.
 MS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
               0.25, 0.5, 1.0, 2.5)
+# Log-spaced edges for norm-valued observations (the training-health plane's
+# gradient-norm distribution): healthy norms cluster around O(1); the decades
+# on either side are where vanishing/exploding shows up.
+NORM_BUCKETS = (0.001, 0.01, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 100.0,
+                1000.0)
 
 # Per-family default-bucket overrides, keyed by metric-name prefix (a family
 # matches ``name == prefix`` or ``name.startswith(prefix + '.')``; the
@@ -45,6 +50,7 @@ MS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 # family keep SECONDS_BUCKETS — the pre-existing default is unchanged.
 BUCKET_FAMILIES: Dict[str, Tuple[Number, ...]] = {
     "serve.latency_s": MS_BUCKETS,
+    "train.health.grad_norm": NORM_BUCKETS,
 }
 
 
@@ -233,6 +239,13 @@ class Registry:
         for the process)."""
         with self._lock:
             self._metrics.clear()
+            self._events.clear()
+
+    def clear_events(self):
+        """Drop the event ring only, keeping instruments — for consumers
+        (tests, a snapshot-and-reset exporter) that need a clean anomaly
+        window without discarding counters other subsystems still hold."""
+        with self._lock:
             self._events.clear()
 
 

@@ -93,6 +93,10 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def set(self, **args):
+        """No-op twin of :meth:`_Span.set` (disabled mode)."""
+        return self
+
 
 _NULL_SPAN = _NullSpan()
 
@@ -106,6 +110,16 @@ class _Span:
         self.name = name
         self.args = args
         self._t0 = 0
+
+    def set(self, **args):
+        """Merge args onto a LIVE span (recorded at exit) — for values that
+        only exist after the span opened, e.g. the request id a serving
+        dispatch assigns mid-span. Returns the span for chaining."""
+        if self.args is None:
+            self.args = args
+        else:
+            self.args.update(args)
+        return self
 
     def __enter__(self):
         self._t0 = time.perf_counter_ns()

@@ -11,14 +11,31 @@ run restarted with the same command continues where it stopped.
 from typing import Any, Callable, Iterable, Optional, Union
 
 import jax
+import numpy as np
 
 from autodist_tpu import const, telemetry
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.runner import TrainState
+from autodist_tpu.telemetry import health as _health
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import ThroughputMeter
 
 PyTree = Any
+
+
+def _observe_health(monitor, runner, step: int, losses,
+                    state: TrainState):
+    """Feed the health monitor at a log boundary (where the loss readback
+    already synced) and apply the halt policy: ``losses`` is the period's
+    per-step loss values (host-side), the bundle is the runner's latest
+    device readback. Raises :class:`telemetry.HealthHalt` with the LIVE
+    state attached under ``AUTODIST_HEALTH_ACTION=halt``."""
+    bundle = getattr(runner, "last_health", None)
+    if bundle is not None:
+        bundle = jax.device_get(bundle)
+    anomalies = monitor.observe(step, losses, bundle)
+    if anomalies and monitor.should_halt:
+        raise _health.HealthHalt(step, state, anomalies)
 
 
 def _make_meter(first_batch: PyTree, batch_size: Optional[int],
@@ -51,7 +68,8 @@ def train(runner, params: PyTree,
           eval_batch: Any = None,
           eval_fn: Optional[Callable] = None,
           on_eval: Optional[Callable[[int, Any], None]] = None,
-          unroll: int = 1) -> TrainState:
+          unroll: int = 1,
+          health_monitor: Optional["_health.HealthMonitor"] = None) -> TrainState:
     """Run ``steps`` global steps, checkpointing and resuming automatically.
 
     ``batches``: either ``fn(step_index) -> batch`` or an iterable of batches
@@ -82,6 +100,16 @@ def train(runner, params: PyTree,
     post-warmup steps, and ``on_metrics`` receives the block's last loss).
     Runners without fused support (async-PS, remote workers) fall back to the
     per-step loop with a warning.
+
+    ``health_monitor`` overrides the ``AUTODIST_HEALTH`` default (a
+    :class:`telemetry.HealthMonitor`, or the flag builds one): the monitor
+    consumes each log period's per-step losses plus the runner's fused
+    on-device numerics bundle at the SAME boundary where the loss readback
+    already syncs — zero extra dispatches, zero extra syncs. Anomalies
+    (NaN/Inf, loss spikes) become ``health.anomaly`` events and follow the
+    ``AUTODIST_HEALTH_ACTION`` policy; ``halt`` raises
+    :class:`telemetry.HealthHalt` carrying the live state. Monitoring needs
+    ``log_every > 0`` (boundaries are where readbacks happen).
     """
     if unroll < 1:
         raise ValueError("unroll must be >= 1")
@@ -123,6 +151,13 @@ def train(runner, params: PyTree,
                 next(batch_iter)
             except StopIteration:
                 return state
+    monitor = health_monitor if health_monitor is not None \
+        else _health.HealthMonitor.from_env()
+    if monitor is not None and not log_every:
+        logging.warning("train: health monitors need log_every > 0 (the "
+                        "bundle readback rides log boundaries); disabling "
+                        "them for this run")
+        monitor = None
     use_blocks = (unroll > 1 and getattr(runner, "supports_run_many", False)
                   and not getattr(runner, "_is_remote_worker", False))
     if unroll > 1 and not use_blocks:
@@ -146,10 +181,15 @@ def train(runner, params: PyTree,
             runner, state, next_batch, batch_iter, start, steps, unroll,
             saver, prefix_base, save_participant, save_every, async_save,
             log_every, batch_size, on_metrics, eval_every, eval_batch,
-            eval_fn, on_eval))
+            eval_fn, on_eval, monitor))
 
     meter = None
     loss = None
+    # Health monitoring: per-step device losses accumulate here (tiny device
+    # scalars, no sync) and are read back together at the log boundary — so
+    # the spike detector sees EVERY step's loss while the loop still syncs
+    # only once per period.
+    pending_losses = []
     for step_i in range(start, steps):
         if next_batch is not None:
             with telemetry.span("train.data_wait"):
@@ -164,6 +204,8 @@ def train(runner, params: PyTree,
         with telemetry.span("train.dispatch"):
             state, fetched = runner.run(state, batch)
         loss = fetched[0] if isinstance(fetched, tuple) else fetched
+        if monitor is not None:
+            pending_losses.append(loss)
         if meter is None and log_every:
             meter = _make_meter(batch, batch_size, log_every)
         if meter is not None:
@@ -193,6 +235,10 @@ def train(runner, params: PyTree,
                     # the opt-state footprint ZeRO sharding divides).
                     telemetry.sample_device_memory(opt_state=state.opt_state)
                     telemetry.emit_metrics(global_step=step_i + 1)
+                if monitor is not None:
+                    _observe_health(monitor, runner, step_i + 1,
+                                    jax.device_get(pending_losses), state)
+                    pending_losses = []
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
         if (eval_every and (step_i + 1) % eval_every == 0
@@ -215,6 +261,12 @@ def train(runner, params: PyTree,
                 saver.save(state, prefix_base, runner=runner,
                            async_write=async_save)
 
+    if monitor is not None and pending_losses:
+        # End-of-run flush: a NaN in the final partial period (steps not a
+        # multiple of log_every) must still anomaly/snapshot/halt — the
+        # monitor's contract is EVERY step observed, not every full period.
+        _observe_health(monitor, runner, steps,
+                        jax.device_get(pending_losses), state)
     if meter is not None:
         meter.finish()   # freeze the run clock: average stays the TRAIN rate
     return _finish(state)
@@ -225,7 +277,7 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                    saver, prefix_base, save_participant, save_every: int,
                    async_save: bool, log_every: int, batch_size: Optional[int],
                    on_metrics, eval_every: int, eval_batch, eval_fn,
-                   on_eval) -> TrainState:
+                   on_eval, monitor=None) -> TrainState:
     """The fused dispatch-ahead pipeline behind ``train(..., unroll=K)``.
 
     Consecutive batches are gathered into blocks of up to ``unroll`` steps and
@@ -276,11 +328,16 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
 
     meter = None
     step_i = start
+    # Health: the period's per-block loss stacks (device [K] arrays), read
+    # back together at the boundary the meter already syncs.
+    pending_losses = []
     block = gather(step_i)
     while block is not None:
         with telemetry.span("train.dispatch", steps=block.length):
             state, fetched = runner.run_many(state, block)
         losses = fetched[0] if isinstance(fetched, tuple) else fetched
+        if monitor is not None:
+            pending_losses.append(losses)
         step_i += block.length
         # Dispatch-ahead: run_many returns as soon as the K-step program is
         # enqueued; gather + pre-shard the next block NOW, before any sync
@@ -308,6 +365,11 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                     # opt-state footprint ZeRO sharding divides).
                     telemetry.sample_device_memory(opt_state=state.opt_state)
                     telemetry.emit_metrics(global_step=step_i)
+                if monitor is not None:
+                    flat = np.concatenate([np.asarray(l).reshape(-1) for l
+                                           in jax.device_get(pending_losses)])
+                    _observe_health(monitor, runner, step_i, flat, state)
+                    pending_losses = []
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)
         if eval_every and step_i % eval_every == 0:
@@ -325,6 +387,12 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                 saver.save(state, prefix_base, runner=runner,
                            async_write=async_save)
         block = next_block
+    if monitor is not None and pending_losses:
+        # End-of-run flush (same contract as the per-step loop): the final
+        # partial period's losses/bundle still reach the monitor.
+        flat = np.concatenate([np.asarray(l).reshape(-1) for l
+                               in jax.device_get(pending_losses)])
+        _observe_health(monitor, runner, step_i, flat, state)
     if meter is not None:
         meter.finish()   # freeze the run clock: average stays the TRAIN rate
     return state

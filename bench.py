@@ -14,6 +14,7 @@ single-V100 numbers; the north star is per-chip >= that).
 
 import argparse
 import json
+import math
 import time
 
 import numpy as np
@@ -261,6 +262,142 @@ def telemetry_overhead(steps: int = 150):
                       f"rate, below the recorded {floor:.2f}x floor — "
                       f"enabled-mode recording got costlier (see "
                       f"PERF_BASELINE.json telemetry_overhead)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    return result
+
+
+def health_overhead(steps: int = 60, rounds: int = 3):
+    """Training-health monitor cost micro-bench (the CPU transformer
+    micro-model at a training-shaped batch):
+
+    - ``bundle_ms`` — the DIRECT cost of the fused numerics bundle
+      (``telemetry.health.device_bundle`` jitted over the model's own
+      param-shaped trees, min of ``rounds`` timed loops), and the implied
+      ``overhead_pct`` = bundle time as a fraction of the measured
+      monitors-DISABLED step time. This is the gated number: the recorded
+      ``health_overhead`` row in PERF_BASELINE.json carries
+      ``max_overhead_pct`` (2.0) — the bundle growing past ~2% of a
+      host-bound step means it stopped being a few fused reductions (the
+      same machine-relative construction as the telemetry row's span-ns
+      gate, so it gates everywhere).
+    - the steps/s pair through ``runner.run`` with monitors disabled vs
+      enabled (best of ``rounds`` interleaved rounds, the enabled side
+      paying a real monitor boundary each round) — cross-checked against
+      the recorded ratio floor only on a matching platform: absolute
+      steps/s pairs are load-noisy on shared boxes, so the ratio floor is
+      a wide backstop against gross fusion/donation regressions, not the
+      primary gate.
+    """
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry import health as _health
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    # A training-shaped batch (not the dispatch-stress micro shape): the
+    # bundle's cost is O(params) and independent of the batch, so the gate
+    # ratio must be taken against a step doing a real batch's work.
+    batch_size, seq_len = 32 * n_dev, 32
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+
+    def build(health: bool):
+        ad = AutoDist(strategy_builder=AllReduce())
+        runner = ad.create_distributed_session(
+            loss_fn, params, optax.adam(1e-3), example_batch=batch,
+            health=health)
+        return runner, runner.init(params)
+
+    monitor = _health.HealthMonitor(_health.HealthConfig(action="warn"))
+    runners = {False: build(False), True: build(True)}
+
+    def measure(health: bool, n: int) -> float:
+        runner, state = runners[health]
+        loss = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = runner.run(state, batch)
+        if health:
+            # The boundary work a real train() period pays: one bundle
+            # readback + the host-side monitor pass (inside the timed
+            # window, so the pair covers the WHOLE enabled cost; the
+            # device_get doubles as the completion fence).
+            monitor.observe(n, [float(jax.device_get(loss))],
+                            jax.device_get(runner.last_health))
+        else:
+            _ = jax.device_get(loss)   # completion fence
+        dt = time.perf_counter() - t0
+        runners[health] = (runner, state)
+        return n / dt
+
+    measure(False, 5)   # compile + warmup both programs
+    measure(True, 5)
+    best = {False: 0.0, True: 0.0}
+    for _ in range(rounds):            # interleaved: load noise hits both
+        best[False] = max(best[False], measure(False, steps))
+        best[True] = max(best[True], measure(True, steps))
+    telemetry.clear()
+
+    # Direct bundle cost on the model's own tree shapes (min-of-rounds —
+    # load spikes stretch a round, never shrink one).
+    tree = runners[True][1].params
+    bundle_fn = jax.jit(_health.device_bundle)
+    out = bundle_fn(tree, tree, tree, jnp.float32(1.0))
+    jax.block_until_ready(out)
+    bundle_ms = math.inf
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(100):
+            out = bundle_fn(tree, tree, tree, jnp.float32(1.0))
+        jax.block_until_ready(out)
+        bundle_ms = min(bundle_ms, (time.perf_counter() - t0) * 10.0)
+    step_ms = 1e3 / best[False]
+    overhead_pct = 100.0 * bundle_ms / step_ms
+
+    result = {
+        "metric": f"health_overhead ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size})",
+        "unit": "steps/s",
+        "rows": {"disabled": round(best[False], 2),
+                 "enabled": round(best[True], 2)},
+        "enabled_vs_disabled": round(best[True] / best[False], 4),
+        "bundle_ms": round(bundle_ms, 4),
+        "overhead_pct": round(overhead_pct, 3),
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("health_overhead")
+        if recorded:
+            max_pct = recorded.get("max_overhead_pct", 2.0)
+            if overhead_pct > max_pct:
+                print(f"WARNING: the fused health bundle costs "
+                      f"{overhead_pct:.2f}% of a host-bound step, above the "
+                      f"{max_pct}% gate — it grew beyond a few fused "
+                      f"reductions (see PERF_BASELINE.json health_overhead)",
+                      file=sys.stderr)
+            floor = recorded.get("enabled_vs_disabled_floor")
+            if (floor and recorded.get("platform") == platform
+                    and result["enabled_vs_disabled"] < floor):
+                print(f"WARNING: health-enabled steps/s is "
+                      f"{result['enabled_vs_disabled']:.2f}x the disabled "
+                      f"rate, below the recorded {floor:.2f}x floor — "
+                      f"enabled-mode monitoring got costlier (see "
+                      f"PERF_BASELINE.json health_overhead)",
                       file=sys.stderr)
     except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
         pass  # a missing/mangled snapshot must not break the bench
@@ -764,6 +901,14 @@ def main(argv=None):
              "row in PERF_BASELINE.json (disabled mode must stay within "
              "max_disabled_overhead_pct of step time)")
     parser.add_argument(
+        "--health-overhead", action="store_true",
+        help="measure the training-health monitor cost on the CPU "
+             "micro-model: steps/s with the fused on-device numerics bundle "
+             "disabled vs enabled (best of interleaved rounds), gated "
+             "against max_overhead_pct in the PERF_BASELINE.json "
+             "health_overhead row (enabled monitors must stay within 2%% "
+             "of a host-bound step)")
+    parser.add_argument(
         "--trace-pull-overhead", action="store_true",
         help="measure the cluster trace plane's pull cost: fill the span "
              "ring to capacity, report the chief-side snapshot+encode stall "
@@ -796,6 +941,9 @@ def main(argv=None):
         return
     if args.telemetry_overhead:
         telemetry_overhead()
+        return
+    if args.health_overhead:
+        health_overhead()
         return
     if args.trace_pull_overhead:
         trace_pull_overhead()

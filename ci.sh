@@ -99,6 +99,9 @@ python bench.py --wire
 # Telemetry cost gate: disabled-mode span overhead must stay within
 # max_disabled_overhead_pct (PERF_BASELINE.json telemetry_overhead row).
 python bench.py --telemetry-overhead
+# Training-health monitor gate: the fused on-device numerics bundle must
+# stay within max_overhead_pct of a host-bound step (health_overhead row).
+python bench.py --health-overhead
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead

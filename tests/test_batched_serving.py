@@ -428,9 +428,10 @@ def test_loopback_server_client_end_to_end(lm, greedy_engine):
             assert res is not None, "client thread did not finish"
             toks, timing = res
             np.testing.assert_array_equal(ref, toks)
-            assert set(timing) == {"queue_s", "prefill_s", "decode_s",
-                                   "total_s"}
+            assert set(timing) == {"request_id", "queue_s", "prefill_s",
+                                   "decode_s", "total_s"}
             assert timing["total_s"] >= 0.0
+            assert isinstance(timing["request_id"], int)
 
         c = serving.ServeClient(server.address)
         try:

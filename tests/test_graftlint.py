@@ -432,8 +432,8 @@ def test_gl006_flags_serving_op_without_dispatch_arm(tmp_path):
 
 
 def test_gl006_clean_serving_protocol(tmp_path):
-    """The real serving vocabulary (generate/infer/stats/ping), method-style
-    dispatcher, every op armed — clean."""
+    """The real serving vocabulary (generate/infer/stats/status/record/ping),
+    method-style dispatcher, every op armed — clean."""
     res = lint(tmp_path, """
         class InferenceServer:
             def _dispatch(self, msg):
@@ -444,6 +444,10 @@ def test_gl006_clean_serving_protocol(tmp_path):
                     return ("ok",)
                 if op == "stats":
                     return ("ok", {})
+                if op == "status":
+                    return ("ok", {})
+                if op == "record":
+                    return ("ok", "/tmp/snap")
                 if op == "ping":
                     return ("ok", None)
                 return ("error", "ServeError", "unknown")
@@ -457,6 +461,12 @@ def test_gl006_clean_serving_protocol(tmp_path):
 
             def stats(self):
                 return self._client.call("stats")[0]
+
+            def status(self):
+                return self._client.call("status")[0]
+
+            def record(self, reason):
+                return self._client.call("record", reason)[0]
 
             def ping(self):
                 return self._client.call("ping")

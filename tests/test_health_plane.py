@@ -1,0 +1,465 @@
+"""Training-health plane + flight recorder + live ops console.
+
+Covers the PR 8 contract end to end (docs/usage/observability.md "Training
+health monitors" / "Flight recorder" / "Live console"):
+
+- the fused on-device numerics bundle (NaN/Inf probes, grad/update/param
+  norms) and its unroll-block reduction;
+- the host monitor's EWMA loss-spike z-score and non-finite detection;
+- the end-to-end trigger proof: an induced NaN batch inside ``train()``
+  produces a complete flight-recorder snapshot dir with NO human action,
+  and an induced watchdog stall does the same;
+- the snapshot dir schema (manifest/metrics/events/trace), ring eviction at
+  K, debounce vs manual bypass;
+- ``halt`` raising :class:`telemetry.HealthHalt` with the live state intact;
+- health PARITY: enabling monitors changes no trained params (bit-identical
+  step outputs, per-step AND ``unroll=K``);
+- the ``status``/``record`` wire opcodes on a loopback PSServer and
+  ``tools/adtop.py --once`` rendering against it;
+- ``dump_events_jsonl`` + ``tracedump --events`` instant-marker merge;
+- the new ``AUTODIST_HEALTH*`` / ``AUTODIST_RECORDER*`` flag registrations.
+
+Pure in-process host tests — no subprocess spawns (GL008-clean), named to
+sort inside the tier-1 window (before test_image_data).
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist, const, telemetry, train  # noqa: E402
+from autodist_tpu.strategy import AllReduce  # noqa: E402
+from autodist_tpu.telemetry import health, recorder  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    """Leave process-global telemetry as found: disabled, empty span ring,
+    empty EVENT ring (anomaly records from one test must not bleed into the
+    next test's snapshot/adtop/tracedump output), no installed recorder
+    (instruments stay — the registry is additive-only and shared)."""
+    telemetry.disable()
+    telemetry.clear()
+    telemetry.registry().clear_events()
+    recorder.set_recorder(None)
+    yield
+    telemetry.disable()
+    telemetry.clear()
+    telemetry.registry().clear_events()
+    recorder.set_recorder(None)
+
+
+# ------------------------------------------------------------------ fixtures
+
+def _loss(p, b):
+    return jnp.mean((b["y"] - b["x"] @ p["w"]) ** 2)
+
+
+def _params():
+    return {"w": np.random.RandomState(0).randn(4, 1).astype(np.float32)}
+
+
+def _batch(i, nan_at=None):
+    rng = np.random.RandomState(100 + i)
+    b = {"x": rng.randn(32, 4).astype(np.float32),
+         "y": rng.randn(32, 1).astype(np.float32)}
+    if nan_at is not None and i == nan_at:
+        b["x"] = b["x"] * np.nan
+    return b
+
+
+def _session(health_on):
+    ad = AutoDist(strategy_builder=AllReduce())
+    return ad.create_distributed_session(
+        _loss, _params(), optax.adam(1e-2), example_batch=_batch(0),
+        health=health_on)
+
+
+@pytest.fixture(scope="module")
+def runner_off():
+    return _session(False)
+
+
+@pytest.fixture(scope="module")
+def runner_on():
+    return _session(True)
+
+
+# ------------------------------------------------------- device-side bundle
+
+def test_device_bundle_values_and_nonfinite_probe():
+    g = {"w": jnp.array([3.0, 4.0])}
+    u = {"w": jnp.array([0.3, 0.4])}
+    p = {"w": jnp.array([1.0, 0.0]), "n_steps": jnp.array([7, 8])}  # ints skip
+    b = np.asarray(jax.jit(health.device_bundle)(g, u, p, jnp.float32(0.5)))
+    assert list(b.shape) == [4]
+    assert b[0] == 0.0
+    assert b[1] == pytest.approx(5.0)       # grad L2
+    assert b[2] == pytest.approx(0.5)       # update L2
+    assert b[3] == pytest.approx(1.0)       # param L2 (int leaf skipped)
+    # Any NaN in a tree propagates into its squared norm -> probe flags.
+    g_bad = {"w": jnp.array([np.nan, 1.0])}
+    b2 = np.asarray(jax.jit(health.device_bundle)(g_bad, u, p,
+                                                  jnp.float32(0.5)))
+    assert b2[0] >= 1.0 and not np.isfinite(b2[1])
+    # A non-finite loss flags even with clean trees.
+    b3 = np.asarray(jax.jit(health.device_bundle)(g, u, p,
+                                                  jnp.float32(np.inf)))
+    assert b3[0] >= 1.0
+
+
+def test_reduce_bundle_sums_flags_and_maxes_norms():
+    stacked = jnp.array([[0.0, 1.0, 0.2, 5.0],
+                         [2.0, 3.0, 0.1, 4.0],
+                         [1.0, 2.0, 0.3, 6.0]], jnp.float32)
+    out = np.asarray(jax.jit(health.reduce_bundle)(stacked))
+    assert out[0] == 3.0                    # nonfinite flags SUM
+    assert out[1] == 3.0 and out[2] == pytest.approx(0.3) and out[3] == 6.0
+
+
+# ------------------------------------------------------------- host monitor
+
+def test_monitor_loss_spike_zscore_and_gauges():
+    mon = health.HealthMonitor(health.HealthConfig(action="warn", z_max=4.0))
+    bundle = np.array([0.0, 1.0, 0.01, 2.0], np.float32)
+    rng = np.random.RandomState(3)
+    for step in range(1, 30):               # steady plateau builds the EWMA
+        assert mon.observe(step, [1.0 + 0.01 * rng.randn()], bundle) == []
+    found = mon.observe(30, [50.0], bundle)
+    assert [a["kind"] for a in found] == ["loss_spike"]
+    assert found[0]["z"] > 4.0
+    snap = telemetry.snapshot()
+    assert snap["train.health.grad_norm"] == 1.0
+    assert snap["train.health.update_ratio"] == pytest.approx(0.005)
+    assert snap["train.health.loss_z"] > 4.0
+    assert snap["train.health.anomalies"] >= 1
+    # The grad-norm distribution resolves the NORM_BUCKETS family.
+    assert "le:0.001" in snap["train.health.grad_norm.dist"]
+    # The anomaly is a structured event too.
+    assert any(e["name"] == "health.anomaly" and e["kind"] == "loss_spike"
+               for e in telemetry.events())
+
+
+def test_monitor_nonfinite_bundle_triggers_recorder(tmp_path):
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=4,
+                                  min_interval_s=0.0)
+    mon = health.HealthMonitor(health.HealthConfig(action="record"),
+                               recorder=rec)
+    found = mon.observe(7, [1.0], np.array([1.0, np.nan, 0.1, 2.0]))
+    assert [a["kind"] for a in found] == ["nonfinite"]
+    snaps = rec.snapshots()
+    assert len(snaps) == 1 and "health.nonfinite" in snaps[0]
+    # NaN losses flag as nonfinite even without a bundle (async/PS loops).
+    mon2 = health.HealthMonitor(health.HealthConfig(action="warn"))
+    assert [a["kind"] for a in mon2.observe(1, [np.nan], None)] \
+        == ["nonfinite"]
+
+
+# ------------------------------------------------- flight recorder mechanics
+
+def test_snapshot_dir_schema_pinned(tmp_path):
+    telemetry.enable()
+    with telemetry.span("work.unit", idx=1):
+        pass
+    telemetry.event("health.anomaly", kind="loss_spike", step=9, z=7.1)
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=4,
+                                  min_interval_s=0.0)
+    path = rec.record("schema_pin")
+    assert sorted(os.listdir(path)) == sorted(recorder.SNAPSHOT_FILES)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    for key in ("reason", "seq", "t_wall_s", "host", "pid", "flags",
+                "versions", "files"):
+        assert key in manifest
+    assert manifest["reason"] == "schema_pin"
+    metrics = json.load(open(os.path.join(path, "metrics.json")))
+    assert isinstance(metrics, dict)
+    doc = json.load(open(os.path.join(path, "trace.json")))
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert "work.unit" in names             # the local ring made it in
+    marks = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert any(m["name"] == "health.anomaly" for m in marks)
+    events = telemetry.load_events_jsonl(os.path.join(path, "events.jsonl"))
+    assert any(e["name"] == "health.anomaly" and e["kind"] == "loss_spike"
+               for e in events)
+
+
+def test_snapshot_ring_evicts_at_k(tmp_path):
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=3,
+                                  min_interval_s=0.0)
+    for i in range(5):
+        assert rec.record(f"r{i}") is not None
+    snaps = rec.snapshots()
+    assert len(snaps) == 3
+    assert [os.path.basename(s) for s in snaps] == \
+        ["snap-0002-w0-r2", "snap-0003-w0-r3", "snap-0004-w0-r4"]
+
+
+def test_snapshot_ring_numeric_order_past_five_digits(tmp_path):
+    """Eviction order is NUMERIC seq order: snap-10000 is newer than
+    snap-9999 (a lexicographic sort would evict the newest dir the moment
+    the counter grows a digit)."""
+    base = tmp_path / "fr"
+    for name in ("snap-10000-w0-r", "snap-9999-w0-r"):
+        (base / name).mkdir(parents=True)
+    rec = recorder.FlightRecorder(str(base), keep=8, min_interval_s=0.0)
+    assert [os.path.basename(p) for p in rec.snapshots()] == \
+        ["snap-9999-w0-r", "snap-10000-w0-r"]
+    assert rec._seq == 10001
+
+
+def test_debounce_blocks_auto_but_not_manual(tmp_path):
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=8,
+                                  min_interval_s=3600.0)
+    assert rec.maybe_record("first") is not None
+    assert rec.maybe_record("second") is None        # inside the window
+    assert rec.record("manual") is not None          # bypasses the debounce
+    assert len(rec.snapshots()) == 2
+
+
+def test_maybe_record_is_noop_unarmed(tmp_path, monkeypatch):
+    assert recorder.maybe_record("nothing") is None  # no recorder, flag off
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=2,
+                                  min_interval_s=0.0)
+    recorder.set_recorder(rec)
+    assert recorder.maybe_record("armed") is not None
+
+
+# ------------------------------------------------------ end-to-end in train()
+
+def test_induced_nan_writes_snapshot_with_no_human_action(runner_on,
+                                                          tmp_path):
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=8,
+                                  min_interval_s=0.0)
+    mon = health.HealthMonitor(health.HealthConfig(action="record"),
+                               recorder=rec)
+    state = train(runner_on, _params(), lambda i: _batch(i, nan_at=2),
+                  steps=5, log_every=1, health_monitor=mon)
+    assert int(state.step) == 5             # record does not stop the run
+    assert any(a["kind"] == "nonfinite" for a in mon.anomalies)
+    snaps = rec.snapshots()
+    assert snaps, "the induced NaN produced no flight-recorder snapshot"
+    assert sorted(os.listdir(snaps[0])) == sorted(recorder.SNAPSHOT_FILES)
+    assert json.load(open(os.path.join(snaps[0], "trace.json")))
+
+
+def test_halt_raises_cleanly_with_state_intact(runner_on):
+    mon = health.HealthMonitor(health.HealthConfig(action="halt"))
+    with pytest.raises(health.HealthHalt) as ei:
+        train(runner_on, _params(), lambda i: _batch(i, nan_at=2),
+              steps=8, log_every=1, health_monitor=mon)
+    err = ei.value
+    # The NaN enters at step index 2; the boundary observing it is step 3+.
+    assert 3 <= err.step <= 8
+    assert int(err.state.step) == err.step  # the LIVE state rides the raise
+    assert jax.device_get(err.state.params)["w"].shape == (4, 1)
+    assert any(a["kind"] == "nonfinite" for a in err.anomalies)
+
+
+def test_health_parity_params_bit_identical(runner_off, runner_on):
+    s_off, s_on = runner_off.init(_params()), runner_on.init(_params())
+    for i in range(4):
+        s_off, _ = runner_off.run(s_off, _batch(i))
+        s_on, _ = runner_on.run(s_on, _batch(i))
+    np.testing.assert_array_equal(jax.device_get(s_off.params)["w"],
+                                  jax.device_get(s_on.params)["w"])
+    # unroll=K: the scanned body with the bundle stays bit-identical too.
+    blocks = [_batch(i) for i in range(4, 8)]
+    s_off, _ = runner_off.run_many(s_off, blocks)
+    s_on, _ = runner_on.run_many(s_on, blocks)
+    np.testing.assert_array_equal(jax.device_get(s_off.params)["w"],
+                                  jax.device_get(s_on.params)["w"])
+
+
+def test_tail_partial_period_still_observed(runner_on):
+    """steps NOT a multiple of log_every: a NaN in the final partial period
+    must still reach the monitor (end-of-run flush) — the last boundary
+    would otherwise silently drop it."""
+    mon = health.HealthMonitor(health.HealthConfig(action="warn"))
+    state = train(runner_on, _params(), lambda i: _batch(i, nan_at=4),
+                  steps=5, log_every=4, health_monitor=mon)
+    assert int(state.step) == 5
+    assert any(a["kind"] == "nonfinite" for a in mon.anomalies)
+
+
+def test_unroll_block_reduce_surfaces_mid_block_nan(runner_on):
+    state = runner_on.init(_params())
+    blocks = [_batch(i, nan_at=1) for i in range(3)]   # NaN mid-block
+    state, _ = runner_on.run_many(state, blocks)
+    bundle = np.asarray(jax.device_get(runner_on.last_health))
+    assert bundle.shape == (4,)
+    assert bundle[0] >= 1.0                 # the reduction kept the flag
+
+
+# ------------------------------------------- status/record wire ops + adtop
+
+class _StubPSRunner:
+    """The minimal surface PSServer._dispatch drives (the test_cluster_trace
+    pattern): a real gate + numpy-only ParameterService, no compilation."""
+
+    def __init__(self, num_workers=1, staleness=2):
+        from autodist_tpu.parallel.staleness import (ParameterService,
+                                                     StalenessController)
+        from autodist_tpu.runner import TrainState
+        state = TrainState(step=np.zeros((), np.int32),
+                           params={"w": np.ones((16,), np.float32)},
+                           opt_state=(), ef_state=())
+        self.service = ParameterService(state, lambda s, grads: s)
+        self.controller = StalenessController(num_workers,
+                                              staleness=staleness)
+
+    def add_worker(self, worker_id=None, with_generation=False):
+        wid, gen = self.controller.register_with_generation(worker_id)
+        handle = type("H", (), {"worker_id": wid})()
+        return (handle, gen) if with_generation else handle
+
+
+def _loopback(num_workers=1, staleness=2, **server_kw):
+    from autodist_tpu.parallel.ps_transport import PSServer
+    server = PSServer(_StubPSRunner(num_workers, staleness),
+                      host="127.0.0.1", **server_kw)
+    return server, "%s:%d" % server.address
+
+
+def test_status_and_record_opcodes_over_loopback(tmp_path):
+    from autodist_tpu.parallel.ps_transport import RemotePSWorker
+
+    recorder.set_recorder(recorder.FlightRecorder(
+        str(tmp_path / "fr"), keep=2, min_interval_s=0.0))
+    server, addr = _loopback(watchdog=False)
+    remote = RemotePSWorker(addr, runner=None, worker_id=0, overlap=False)
+    try:
+        remote._client.call("start_step", 0, 5.0)
+        remote._client.call("finish_step", 0)
+        status = remote.status()
+        assert status["kind"] == "ps"
+        assert status["staleness_bound"] == 2
+        assert status["per_worker"][0]["lag"] == 0
+        assert isinstance(status["events"], list)
+        json.dumps(status)                  # crossed the wire: plain data
+        path = remote.record("operator_asked")
+        assert path and os.path.isdir(path)
+        assert "operator_asked" in path
+        assert sorted(os.listdir(path)) == sorted(recorder.SNAPSHOT_FILES)
+    finally:
+        remote.close()
+        server.close()
+
+
+def test_watchdog_stall_triggers_recorder(tmp_path):
+    import time as _time
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=4,
+                                  min_interval_s=0.0)
+    recorder.set_recorder(rec)
+    server, _ = _loopback(watchdog=True, watchdog_interval=60.0)
+    try:
+        server._runner.controller.register(0)
+        server._stats_for(0)                # create the entry OUTSIDE the lock
+        with server._worker_stats_lock:
+            server._worker_stats[0].last_seen = _time.monotonic() - 9999.0
+        server._watchdog._sample()          # deterministic direct tick
+        assert 0 in server._watchdog.flagged
+        snaps = rec.snapshots()
+        assert snaps and "ps.stall.w0" in snaps[0]
+    finally:
+        server.close()
+
+
+def _adtop():
+    spec = importlib.util.spec_from_file_location(
+        "adtop_cli", os.path.join(os.path.dirname(__file__), os.pardir,
+                                  "tools", "adtop.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_adtop_once_renders_loopback_status(capsys):
+    telemetry.gauge("train.health.grad_norm").set(2.5)
+    telemetry.event("ps.anomaly.stall", worker=0, last_seen_s=42.0)
+    server, addr = _loopback(watchdog=False)
+    try:
+        server._runner.controller.register(0)
+        server._stats_for(0)
+        ad = _adtop()
+        assert ad.main([addr, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "adtop — ps server" in out
+        assert "w0" in out and "bound 2" in out
+        assert "grad_norm 2.5" in out
+        assert "ps.anomaly.stall" in out
+        # --raw ships the JSON payload verbatim.
+        assert ad.main([addr, "--raw"]) == 0
+        assert json.loads(capsys.readouterr().out)["kind"] == "ps"
+    finally:
+        server.close()
+
+
+def test_adtop_errors_cleanly_without_server(capsys):
+    ad = _adtop()
+    assert ad.main(["127.0.0.1:1", "--once"]) == 1
+    assert "cannot read status" in capsys.readouterr().err
+
+
+# --------------------------------------------- events JSONL + tracedump leg
+
+def test_dump_events_jsonl_roundtrip_and_tracedump_merge(tmp_path):
+    telemetry.enable()
+    with telemetry.span("spanned"):
+        pass
+    telemetry.event("health.anomaly", kind="loss_spike", step=3, z=9.9)
+    ring = str(tmp_path / "w0.jsonl")
+    evs = str(tmp_path / "events.jsonl")
+    telemetry.dump_spans_jsonl(ring, worker_id=0)
+    telemetry.dump_events_jsonl(evs)
+    loaded = telemetry.load_events_jsonl(evs)
+    assert loaded and loaded[-1]["kind"] == "loss_spike"
+
+    spec = importlib.util.spec_from_file_location(
+        "tracedump_cli", os.path.join(os.path.dirname(__file__), os.pardir,
+                                      "tools", "tracedump.py"))
+    td = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(td)
+    out = str(tmp_path / "merged.json")
+    assert td.main([out, ring, "--events", evs]) == 0
+    doc = json.load(open(out))
+    marks = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert [m["name"] for m in marks] == ["health.anomaly"]
+    assert marks[0]["args"]["z"] == 9.9
+    # Instant markers get their own labeled lane.
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any("events" in l for l in lanes)
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('["not", "an", "event"]\n')
+    with pytest.raises(ValueError, match="event record"):
+        telemetry.load_events_jsonl(str(bad))
+
+
+# ----------------------------------------------------------- flag registry
+
+def test_new_flags_registered_and_typed(monkeypatch):
+    for flag in ("AUTODIST_HEALTH", "AUTODIST_HEALTH_ACTION",
+                 "AUTODIST_HEALTH_ZMAX", "AUTODIST_RECORDER",
+                 "AUTODIST_RECORDER_DIR", "AUTODIST_RECORDER_KEEP",
+                 "AUTODIST_RECORDER_MIN_S"):
+        assert flag in const.KNOWN_FLAGS
+        assert hasattr(const.ENV, flag)
+    assert const.ENV.AUTODIST_HEALTH.val is False
+    assert const.ENV.AUTODIST_HEALTH_ACTION.val == "warn"
+    assert health.HealthMonitor.from_env() is None     # flag off -> no cost
+    monkeypatch.setenv("AUTODIST_HEALTH", "1")
+    monkeypatch.setenv("AUTODIST_HEALTH_ACTION", "halt")
+    monkeypatch.setenv("AUTODIST_HEALTH_ZMAX", "3.5")
+    mon = health.HealthMonitor.from_env()
+    assert mon is not None and mon.config.action == "halt"
+    assert mon.config.z_max == 3.5
+    with pytest.raises(ValueError, match="action"):
+        health.HealthConfig(action="explode")

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""adtop — a top-style live console for autodist servers.
+
+Polls any PSServer or InferenceServer address over the ``status`` wire opcode
+and renders one screen: uptime and throughput counters, a per-worker table
+(last-seen age, instantaneous staleness lag, gate-entry lag histogram, wire
+traffic) for training endpoints, the queue/batch/in-flight-request table for
+serving endpoints, the ``train.health.*`` gauges when the health monitors are
+on, and the most recent anomaly events (watchdog stalls/stragglers, health
+NaN/spike records).
+
+Usage:
+    python tools/adtop.py HOST:PORT                # live screen, 2s refresh
+    python tools/adtop.py HOST:PORT --interval 5
+    python tools/adtop.py HOST:PORT --once         # one plain-text snapshot
+    python tools/adtop.py HOST:PORT --raw          # one raw JSON snapshot
+
+With no address, ``AUTODIST_PS_ADDR`` then ``AUTODIST_SERVE_ADDR`` is tried.
+``--once``/``--raw`` are what headless boxes, scripts, and the tests use; the
+live screen needs only ANSI clear-home (no curses dependency), so it works in
+any terminal the training job's logs already scroll through.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def fetch_status(address, timeout: float = 10.0) -> dict:
+    """One ``status`` request against ``address`` (``host:port`` or a
+    ``(host, port)`` tuple); raises ConnectionError/PSClientError on an
+    unreachable or pre-``status`` server."""
+    from autodist_tpu.parallel.ps_transport import _PSClient
+    client = _PSClient(address, connect_timeout=timeout)
+    try:
+        return client.call("status")[0]
+    finally:
+        client.close()
+
+
+def _fmt_age(seconds) -> str:
+    seconds = float(seconds)
+    if seconds < 120:
+        return f"{seconds:.1f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def _hist_quantile(hist: dict, q: float):
+    """Approximate quantile from a histogram snapshot dict (``le:<bound>``
+    keys + ``count``): the upper bound of the first bucket whose cumulative
+    count reaches ``q``. None for an empty histogram."""
+    total = hist.get("count", 0)
+    if not total:
+        return None
+    edges = []
+    for key, n in hist.items():
+        if key.startswith("le:") and key != "le:+inf":
+            edges.append((float(key[3:]), n))
+    edges.sort()
+    target = q * total
+    seen = 0
+    for bound, n in edges:
+        seen += n
+        if seen >= target:
+            return bound
+    return float("inf")
+
+
+def _counter(reg: dict, name: str):
+    v = reg.get(name)
+    return v if isinstance(v, (int, float)) else None
+
+
+def _health_lines(reg: dict) -> list:
+    rows = [(k.split("train.health.", 1)[1], v) for k, v in sorted(reg.items())
+            if k.startswith("train.health.") and isinstance(v, (int, float))]
+    if not rows:
+        return []
+    return ["health   " + "  ".join(f"{name} {value:.4g}"
+                                    for name, value in rows)]
+
+
+def _event_lines(events, limit: int = 5) -> list:
+    out = []
+    for rec in list(events)[-limit:]:
+        rec = dict(rec)
+        name = rec.pop("name", "event")
+        t_wall = rec.pop("t_wall_s", None)
+        when = time.strftime("%H:%M:%S", time.localtime(t_wall)) \
+            if t_wall else "--:--:--"
+        fields = " ".join(f"{k}={v}" for k, v in sorted(rec.items()))
+        out.append(f"  {when}  {name}  {fields}")
+    return out
+
+
+def _staleness_compact(hist: dict) -> str:
+    body = ",".join(f"{k[3:]}:{n}" for k, n in hist.items()
+                    if k.startswith("le:") and n)
+    return "{" + body + "}"
+
+
+def render(status: dict, address: str = "") -> str:
+    """One plain-text screen for a ``status`` payload (PS or serving kind) —
+    the single rendering path behind ``--once`` and the live loop, so tests
+    pin exactly what operators see."""
+    kind = status.get("kind", "?")
+    reg = status.get("registry", {}) or {}
+    lines = [f"adtop — {kind} server {address}  "
+             f"up {_fmt_age(status.get('uptime_s', 0))}  "
+             f"{time.strftime('%H:%M:%S')}"]
+    if status.get("error"):
+        # A failed poll (live loop) must say WHY on screen, not silently
+        # blank the tables — the operator needs refused-vs-timeout-vs-dead.
+        lines.append(f"ERROR    {status['error']}")
+    wire = status.get("wire") or {}
+    if wire:
+        lines.append(f"wire     tx {wire.get('bytes_sent', 0):,}B/"
+                     f"{wire.get('msgs_sent', 0)}msg  "
+                     f"rx {wire.get('bytes_received', 0):,}B/"
+                     f"{wire.get('msgs_received', 0)}msg")
+    if kind == "ps":
+        bound = status.get("staleness_bound")
+        version = status.get("version")
+        head = f"gate     bound {bound if bound is not None else 'inf'}"
+        if version is not None:
+            head += f"  version {version}"
+        shards = status.get("shard_versions")
+        if shards:
+            head += f"  shards {shards}"
+        lines.append(head)
+        per_worker = status.get("per_worker", {}) or {}
+        if per_worker:
+            lines.append("worker   last-seen  lag  staleness            wire")
+            for wid in sorted(per_worker, key=str):
+                w = per_worker[wid]
+                seen = _fmt_age(w["last_seen_s"]) \
+                    if "last_seen_s" in w else "?"
+                lag = w.get("lag", "?")
+                stal = _staleness_compact(w.get("staleness", {}) or {})
+                wired = w.get("wire") or {}
+                lines.append(
+                    f"  w{wid:<5} {seen:>9}  {lag!s:>3}  {stal:<20} "
+                    f"rx {wired.get('bytes_received', 0):,}B")
+    elif kind == "serve":
+        cap = status.get("capacity", 0)
+        in_flight = status.get("in_flight", []) or []
+        lines.append(f"queue    depth {status.get('queue_depth', 0)}  "
+                     f"slots {len(in_flight)}/{cap}  "
+                     f"mode {status.get('mode', '?')}  "
+                     f"engine {status.get('engine', '?')}")
+        done = _counter(reg, "serve.requests.completed")
+        rej = _counter(reg, "serve.requests.rejected")
+        total = reg.get("serve.latency_s.total")
+        if isinstance(total, dict):
+            p50 = _hist_quantile(total, 0.5)
+            p99 = _hist_quantile(total, 0.99)
+            lines.append(
+                f"slo      done {done or 0}  rejected {rej or 0}  "
+                f"p50<= {p50 if p50 is not None else '-'}s  "
+                f"p99<= {p99 if p99 is not None else '-'}s")
+        if in_flight:
+            lines.append("request  slot   age  tokens  prompt")
+            for r in in_flight:
+                lines.append(f"  #{r.get('request_id', '?'):<6} "
+                             f"{r.get('slot', '?')!s:>4} "
+                             f"{_fmt_age(r.get('age_s', 0)):>5}  "
+                             f"{r.get('tokens', 0):>6}  "
+                             f"{r.get('prompt_len', 0):>6}")
+    lines.extend(_health_lines(reg))
+    events = status.get("events") or status.get("anomalies") or []
+    if events:
+        lines.append(f"events   ({len(events)} recorded, newest last)")
+        lines.extend(_event_lines(events))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="adtop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("address", nargs="?", default=None,
+                    help="server host:port (default: AUTODIST_PS_ADDR, then "
+                         "AUTODIST_SERVE_ADDR)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (headless/test mode)")
+    ap.add_argument("--raw", action="store_true",
+                    help="print one raw JSON status payload and exit")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for the live screen (default 2)")
+    args = ap.parse_args(argv)
+    address = args.address
+    if address is None:
+        from autodist_tpu import const
+        address = str(const.ENV.AUTODIST_PS_ADDR.val) \
+            or str(const.ENV.AUTODIST_SERVE_ADDR.val)
+    if not address:
+        print("adtop: no address given and neither AUTODIST_PS_ADDR nor "
+              "AUTODIST_SERVE_ADDR is set", file=sys.stderr)
+        return 2
+    try:
+        status = fetch_status(address)
+    except Exception as e:
+        print(f"adtop: cannot read status from {address}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.raw:
+        print(json.dumps(status, default=str, indent=1))
+        return 0
+    if args.once:
+        print(render(status, address))
+        return 0
+    try:
+        while True:
+            # ANSI clear + home: a live screen with zero terminal deps.
+            sys.stdout.write("\x1b[2J\x1b[H" + render(status, address) + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+            try:
+                status = fetch_status(address)
+            except Exception as e:
+                status = {"kind": "?", "error": str(e)}
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,11 +12,15 @@ after the fact into the same clock-aligned, pid-lane-per-worker timeline
 Usage:
     python tools/tracedump.py out.json w0.jsonl w1.jsonl [w2.jsonl ...]
     python tools/tracedump.py out.json *.jsonl --offset 1:250000 --offset 2:-80000
+    python tools/tracedump.py out.json *.jsonl --events flightrec/snap-0000-*/events.jsonl
 
 ``--offset WID:NS`` overrides a dump's recorded chief-clock offset
 (nanoseconds to ADD to that worker's wall clock) — for dumps written before
-any offset was estimated. Load the output in ui.perfetto.dev or
-chrome://tracing.
+any offset was estimated. ``--events FILE`` (repeatable) merges structured
+registry-event dumps (``telemetry.dump_events_jsonl`` files — the flight
+recorder writes one per snapshot) into the timeline as INSTANT markers on
+their own lane, so anomalies line up against the spans that surround them.
+Load the output in ui.perfetto.dev or chrome://tracing.
 """
 
 import argparse
@@ -37,11 +41,12 @@ def _parse_offset(spec: str):
             f"--offset wants WID:NANOSECONDS, got {spec!r}")
 
 
-def merge_dumps(out_path: str, inputs, offsets=None) -> str:
+def merge_dumps(out_path: str, inputs, offsets=None, event_files=()) -> str:
     """Merge span JSONL dumps at ``inputs`` into one Chrome trace at
-    ``out_path``; ``offsets`` maps worker id -> clock_offset_ns override.
-    Returns ``out_path`` (the test-facing entry point — main() is argv
-    plumbing around it)."""
+    ``out_path``; ``offsets`` maps worker id -> clock_offset_ns override and
+    ``event_files`` are registry-event JSONL dumps overlaid as instant
+    markers. Returns ``out_path`` (the test-facing entry point — main() is
+    argv plumbing around it)."""
     from autodist_tpu.telemetry import cluster
     offsets = offsets or {}
     states = []
@@ -51,7 +56,11 @@ def merge_dumps(out_path: str, inputs, offsets=None) -> str:
         if wid in offsets:
             state["clock_offset_ns"] = offsets[wid]
         states.append(state)
-    return cluster.merge_trace_states(states, out_path)
+    events = []
+    for path in event_files:
+        events.extend(cluster.load_events_jsonl(path))
+    return cluster.merge_trace_states(states, out_path,
+                                      instant_events=events)
 
 
 def main(argv=None) -> int:
@@ -66,9 +75,14 @@ def main(argv=None) -> int:
                     default=[], metavar="WID:NS",
                     help="override worker WID's chief-clock offset "
                          "(ns to add; repeatable)")
+    ap.add_argument("--events", action="append", default=[], metavar="FILE",
+                    help="registry-event JSONL dump "
+                         "(telemetry.dump_events_jsonl file) to overlay as "
+                         "instant markers (repeatable)")
     args = ap.parse_args(argv)
     try:
-        merge_dumps(args.out, args.inputs, offsets=dict(args.offset))
+        merge_dumps(args.out, args.inputs, offsets=dict(args.offset),
+                    event_files=args.events)
     except (OSError, ValueError) as e:
         print(f"tracedump: {e}", file=sys.stderr)
         return 1
