@@ -98,6 +98,15 @@ KNOWN_FLAGS = {
     "AUTODIST_RECORDER_MIN_S": "min seconds between automatic snapshots "
                                "(an anomaly storm must not write one per "
                                "step); manual `record` requests bypass it",
+    "AUTODIST_PROFILE": "performance-attribution plane: per-program XLA "
+                        "cost records, train.attr.* phase shares, "
+                        "train.mfu/membw_util roofline gauges (implies "
+                        "span recording)",
+    "AUTODIST_PROFILE_DIR": "directory train() writes the per-run profile "
+                            "JSON into at run end (tools/adprof.py reads "
+                            "and diffs these)",
+    "AUTODIST_PEAK_MEMBW": "per-device peak HBM bytes/s override for the "
+                           "membw_util roofline gauge (peak-spec helper)",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -202,6 +211,14 @@ _ENV_DEFAULTS = {
     "AUTODIST_RECORDER_DIR": "",
     "AUTODIST_RECORDER_KEEP": 8,
     "AUTODIST_RECORDER_MIN_S": 30.0,
+    # Performance-attribution plane (autodist_tpu/telemetry/profiling.py):
+    # static per-program cost extraction + phase-share/MFU gauges + the
+    # per-run profile store. Off by default; enabling implies span
+    # recording (attribution joins span durations). AUTODIST_PEAK_MEMBW
+    # pairs with AUTODIST_PEAK_FLOPS as the peak-spec overrides.
+    "AUTODIST_PROFILE": False,
+    "AUTODIST_PROFILE_DIR": "",
+    "AUTODIST_PEAK_MEMBW": "",
 }
 
 class ENV(enum.Enum):
@@ -244,6 +261,9 @@ class ENV(enum.Enum):
     AUTODIST_RECORDER_DIR = "AUTODIST_RECORDER_DIR"
     AUTODIST_RECORDER_KEEP = "AUTODIST_RECORDER_KEEP"
     AUTODIST_RECORDER_MIN_S = "AUTODIST_RECORDER_MIN_S"
+    AUTODIST_PROFILE = "AUTODIST_PROFILE"
+    AUTODIST_PROFILE_DIR = "AUTODIST_PROFILE_DIR"
+    AUTODIST_PEAK_MEMBW = "AUTODIST_PEAK_MEMBW"
 
     @property
     def val(self):

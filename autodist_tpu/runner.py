@@ -26,6 +26,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import telemetry
+from autodist_tpu.telemetry import profiling as _profiling
 from autodist_tpu.model_spec import ModelSpec
 from autodist_tpu.parallel import synchronization
 from autodist_tpu.parallel.mesh import build_mesh
@@ -124,14 +125,18 @@ class _CompileProbe:
     enqueued), so that call's wall time IS the compile cost to within one
     async dispatch. Wraps the would-be dispatch span with a ``jit.compile``
     span and, on exit, bumps ``jit.cache_miss`` and accumulates
-    ``jit.compile_s`` in the telemetry registry. Constructed only in enabled
-    mode (:meth:`DistributedRunner._dispatch_span`)."""
+    ``jit.compile_s`` in the telemetry registry — and, when the profiling
+    plane armed a ``cost_cb``, hands it the compile seconds so the program's
+    static cost record (XLA cost analysis) lands in the per-signature cache.
+    Constructed only in enabled mode
+    (:meth:`DistributedRunner._dispatch_span`)."""
 
-    __slots__ = ("_inner", "_t0")
+    __slots__ = ("_inner", "_t0", "_cost_cb")
 
-    def __init__(self, inner):
+    def __init__(self, inner, cost_cb=None):
         self._inner = inner
         self._t0 = 0.0
+        self._cost_cb = cost_cb
 
     def __enter__(self):
         self._inner.__enter__()
@@ -142,6 +147,8 @@ class _CompileProbe:
         dt = time.perf_counter() - self._t0
         telemetry.counter("jit.cache_miss").inc()
         telemetry.counter("jit.compile_s").inc(dt)
+        if self._cost_cb is not None and exc[0] is None:
+            self._cost_cb(dt)
         return self._inner.__exit__(*exc)
 
 
@@ -685,23 +692,87 @@ class DistributedRunner:
                          f"{getattr(v, 'shape', ())}")
         return "|".join(parts)
 
+    def _extract_program_cost(self, jitted, args, steps: int = 1):
+        """XLA's static cost analysis for ``jitted`` at ``args`` as a plain
+        ``{"flops", "bytes_accessed", "output_bytes"}`` dict, or None when
+        the backend reports nothing. Called right after the first dispatch
+        of a signature compiled, so ``lower().compile()`` hits the
+        executable cache (the same contract ``utils/flops.train_step_flops``
+        relies on); accounting must never break a step, hence the broad
+        guard.
+
+        ``steps`` scales flops/bytes for the fused K-step block program:
+        HloCostAnalysis visits each instruction ONCE and does not model
+        loop trip counts, so a ``lax.scan``-of-K-steps program reports its
+        body's cost, not K of them — the runner knows K and restores it
+        (verified on this backend: the K=4 block reports ~1x the
+        single-step program's flops). The gradient-accumulation scan inside
+        the step body (``accumulate``'s micro loop) is the same shape of
+        under-count, so ``self._accum`` scales too — a slight over-count of
+        the once-per-step optimizer update, accepted because the gradient
+        pass dominates any program accumulation is worth using on."""
+        try:
+            with self.mesh:
+                compiled = jitted.lower(*args).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if not cost:
+                return None
+            k = max(1, int(steps)) * max(1, int(self._accum))
+            # Backends report -1 for properties they don't know (the same
+            # sentinel utils/flops._flops_from_cost guards): only POSITIVE
+            # counts are real.
+            flops = float(cost.get("flops", 0.0) or 0.0)
+            bytes_acc = float(cost.get("bytes accessed", 0.0) or 0.0)
+            if flops <= 0:
+                return None
+            out: dict = {"flops": k * flops,
+                         "bytes_accessed":
+                             k * bytes_acc if bytes_acc > 0 else None}
+            try:
+                mem = compiled.memory_analysis()
+                out["output_bytes"] = int(mem.output_size_in_bytes)
+            except Exception:  # noqa: BLE001 — optional on some backends
+                out["output_bytes"] = None
+            return out
+        except Exception:  # noqa: BLE001
+            return None
+
     def _dispatch_span(self, name: str, kind: str, fetch_fn, batch: PyTree,
-                       **span_args):
+                       cost_probe=None, **span_args):
         """The span wrapping a compiled-step dispatch. Enabled mode only: the
         first dispatch of a NEW shape signature becomes a ``jit.compile``
         span (carrying a crc32 of the signature) whose exit books
         ``jit.cache_miss``/``jit.compile_s`` — so "why was step N slow"
-        answers itself as "a new batch shape recompiled". Disabled mode
-        short-circuits to the shared no-op span."""
+        answers itself as "a new batch shape recompiled". Every dispatch
+        additionally counts against its signature's
+        :class:`telemetry.profiling.ProgramCost` record, and — with the
+        profiling plane active — the first dispatch pulls the compiled
+        program's XLA cost analysis through ``cost_probe`` (the jitted fn
+        plus its args) into that record. Disabled mode short-circuits to
+        the shared no-op span."""
         if not telemetry.enabled():
             return telemetry.span(name)
         sig = self._compile_signature(kind, fetch_fn, batch)
+        digest = format(zlib.crc32(sig.encode()), "08x")
+        steps = int(span_args.get("steps", 1))
+        _profiling.note_dispatch(digest, kind, steps)
         if sig in self._compile_sigs:
             return telemetry.span(name, **span_args)
         self._compile_sigs.add(sig)
+        cost_cb = None
+        if cost_probe is not None and _profiling.active():
+            jitted, jit_args = cost_probe
+
+            def cost_cb(compile_s, _d=digest, _k=kind, _s=steps,
+                        _fn=jitted, _a=jit_args):
+                _profiling.record_program_cost(
+                    _d, _k, _s,
+                    self._extract_program_cost(_fn, _a, steps=_s),
+                    compile_s=compile_s)
         return _CompileProbe(telemetry.span(
-            "jit.compile", kind=kind,
-            sig=format(zlib.crc32(sig.encode()), "08x"), **span_args))
+            "jit.compile", kind=kind, sig=digest, **span_args), cost_cb)
 
     def logical_params(self, state_or_params) -> PyTree:
         """The parameter tree at its original (user-facing, unpadded) shapes."""
@@ -739,7 +810,8 @@ class DistributedRunner:
         # signature is recorded AS compilation (jit.compile span +
         # jit.cache_miss/jit.compile_s counters, see _dispatch_span).
         with self._dispatch_span("runner.run.dispatch", "step", fetches,
-                                 sharded):
+                                 sharded, cost_probe=(step_fn,
+                                                      (state, sharded))):
             with self.mesh:
                 new_state, (loss, aux, fetched, bundle) = step_fn(state,
                                                                   sharded)
@@ -780,7 +852,8 @@ class DistributedRunner:
         if many_fn is None:
             many_fn = self._build_many(fetches)
         with self._dispatch_span("runner.run_many.dispatch", "many", fetches,
-                                 block.tree, steps=block.length):
+                                 block.tree, steps=block.length,
+                                 cost_probe=(many_fn, (state, block.tree))):
             with self.mesh:
                 new_state, (losses, auxes, fetched, bundle) = many_fn(
                     state, block.tree)

@@ -30,6 +30,14 @@ Three planes, one subsystem (docs/usage/observability.md):
   self-contained snapshot dirs (merged cluster trace + metrics/events +
   env manifest) into a bounded latest-K ring; ``tools/adtop.py`` is the
   live console over the ``status`` opcode.
+- **Performance attribution** (:mod:`autodist_tpu.telemetry.profiling` +
+  :mod:`autodist_tpu.telemetry.costmodel`) — ``AUTODIST_PROFILE=1`` caches
+  XLA cost analysis per compiled program signature, decomposes each log
+  period into ``train.attr.*`` phase shares, books ``train.mfu`` /
+  ``train.membw_util`` roofline gauges, and writes a schema-versioned
+  per-run profile (``AUTODIST_PROFILE_DIR``); ``tools/adprof.py`` diffs
+  two profiles and the cost model predicts step time from static costs
+  plus a calibration fitted from one run.
 
 Everything is OFF by default; ``AUTODIST_TELEMETRY=1`` (or
 :func:`telemetry.enable`) turns recording on. Disabled-mode instrumentation
@@ -55,8 +63,12 @@ from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                             Registry, counter, event, events,
                                             gauge, histogram, registry,
                                             snapshot)
-from autodist_tpu.telemetry.recorder import (FlightRecorder, get_recorder,
-                                             maybe_record, set_recorder)
+from autodist_tpu.telemetry import costmodel, profiling
+from autodist_tpu.telemetry.profiling import (peak_spec, profile_document,
+                                              write_profile)
+from autodist_tpu.telemetry.recorder import (FlightRecorder, build_manifest,
+                                             get_recorder, maybe_record,
+                                             set_recorder)
 from autodist_tpu.telemetry.spans import (clear, disable, enable, enabled,
                                           snapshot_spans, span, traced)
 
@@ -73,4 +85,7 @@ __all__ = [
     "dump_events_jsonl", "load_events_jsonl",
     "HealthConfig", "HealthHalt", "HealthMonitor",
     "FlightRecorder", "set_recorder", "get_recorder", "maybe_record",
+    "build_manifest",
+    "profiling", "costmodel", "peak_spec", "profile_document",
+    "write_profile",
 ]

@@ -1,0 +1,173 @@
+"""Calibrated step-time cost model over static program costs.
+
+Automap-style strategy search (ROADMAP item 3, arXiv 2112.02958) needs to
+rank candidate distribution plans WITHOUT running each one to steady state.
+The two ingredients are both shipped by the attribution plane
+(:mod:`autodist_tpu.telemetry.profiling`):
+
+- **static costs** — per-program flops / bytes-accessed from XLA's cost
+  analysis, cached per shape signature at compile time;
+- **a calibration record** — the machine's ACHIEVED rates (flops/s, bytes/s,
+  host seconds per dispatch, wire bytes/s), fitted from a short real run's
+  profile rather than spec sheets, so systematic model error (padding,
+  rematerialization, dispatch overhead) cancels between candidates.
+
+:func:`predict` is the interface the search calls: roofline per program
+(``max(flops/flops_per_s, bytes/bytes_per_s)``), plus per-dispatch host
+overhead (what ``unroll=K`` amortizes) and a bytes/bandwidth wire term for
+plans that cross the PS transport. Shipped here as observability —
+``adprof predict`` surfaces it and tests pin prediction-vs-measured
+agreement on the CPU micro-model — with the search itself left for the
+strategy PR.
+"""
+
+import dataclasses
+from typing import Any, Dict, Iterable, Optional, Union
+
+__all__ = ["Calibration", "calibrate", "predict", "predict_from_profile"]
+
+
+@dataclasses.dataclass
+class Calibration:
+    """Achieved machine rates fitted from one profile (see :func:`calibrate`).
+
+    ``flops_per_s``/``bytes_per_s`` are the rates the device actually
+    sustained during the profiled run's compute phase — NOT hardware peaks;
+    ``host_s_per_dispatch`` is the host-side cost of one program launch
+    (feed sharding + enqueue); ``wire_bytes_per_s`` is the measured PS-wire
+    bandwidth (None for collective-only runs)."""
+
+    flops_per_s: Optional[float] = None
+    bytes_per_s: Optional[float] = None
+    host_s_per_dispatch: float = 0.0
+    wire_bytes_per_s: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Calibration":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+
+def _wire_bytes_per_s(profile: Dict[str, Any]) -> Optional[float]:
+    """Measured PS-wire bandwidth: the profile's ``wire`` block (the
+    ``ps.wire.*`` registry counters ``profile_document`` attaches when the
+    run mirrored any transport traffic) over the comm phase's wall seconds;
+    None for collective-only runs, which cross no wire."""
+    wire = profile.get("wire") or {}
+    total_bytes = (wire.get("bytes_sent", 0) or 0) \
+        + (wire.get("bytes_received", 0) or 0)
+    summary = profile.get("summary") or {}
+    shares = summary.get("shares") or {}
+    comm_s = (shares.get("comm") or 0.0) * (summary.get("wall_s") or 0.0)
+    if total_bytes and comm_s > 0:
+        return total_bytes / comm_s
+    return None
+
+
+def calibrate(profile: Dict[str, Any]) -> Calibration:
+    """Fit a :class:`Calibration` from one profile document (the dict
+    :func:`telemetry.write_profile` wrote / ``profile_document`` returned).
+
+    The compute phase's wall seconds anchor the achieved rates: the profiled
+    run dispatched ``flops_per_step * steps`` flops and its loop sat parked
+    behind the device for ``compute_share * wall_s`` seconds, so the
+    sustained rate is their quotient (same for bytes). Degenerate profiles
+    (no compute residual — a fully host-bound run) fall back to whole-wall
+    rates, which keeps predictions conservative rather than infinite."""
+    summary = profile.get("summary") or {}
+    shares = summary.get("shares") or {}
+    wall_s = summary.get("wall_s") or 0.0
+    steps = summary.get("steps") or 0
+    compute_s = (shares.get("compute") or 0.0) * wall_s
+    if compute_s <= 0:
+        compute_s = wall_s
+    flops_step = summary.get("flops_per_step")
+    bytes_step = summary.get("bytes_per_step")
+    return Calibration(
+        flops_per_s=(flops_step * steps / compute_s)
+        if flops_step and steps and compute_s > 0 else None,
+        bytes_per_s=(bytes_step * steps / compute_s)
+        if bytes_step and steps and compute_s > 0 else None,
+        host_s_per_dispatch=summary.get("host_s_per_dispatch") or 0.0,
+        wire_bytes_per_s=_wire_bytes_per_s(profile),
+    )
+
+
+def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
+            calib: Calibration,
+            comm_bytes_per_step: float = 0.0) -> Dict[str, Any]:
+    """Predict per-step time for a candidate plan's program set.
+
+    ``plan_costs``: one program-cost dict or an iterable of them — the
+    ``{"flops", "bytes_accessed", "steps", "dispatches"}`` records a
+    profile's ``programs`` table holds, flops/bytes PER DISPATCH (a
+    ``steps=K`` fused block counts as one dispatch advancing K steps;
+    ``dispatches`` defaults to 1 and weights the program's contribution).
+    Per dispatch the device time is the roofline ``max(flops/flops_per_s,
+    bytes/bytes_per_s)`` — whichever resource binds — plus
+    ``calib.host_s_per_dispatch`` for the launch; ``comm_bytes_per_step``
+    over the calibrated wire bandwidth adds the PS transfer term.
+
+    Returns ``{"step_s", "steps_per_s", "bound", "breakdown": {compute_s,
+    memory_s, host_s, comm_s per step}}`` — ``bound`` names the binding
+    resource, the MLPerf-style "what do I fix first" answer."""
+    if isinstance(plan_costs, dict):
+        plan_costs = [plan_costs]
+    compute_s = memory_s = device_s = 0.0
+    host_s = 0.0
+    total_steps = 0
+    for rec in plan_costs:
+        n = max(1, int(rec.get("dispatches") or 1))
+        steps = int(rec.get("steps") or 1)
+        total_steps += n * steps
+        c = (rec.get("flops") or 0.0) / calib.flops_per_s \
+            if calib.flops_per_s else 0.0
+        m = (rec.get("bytes_accessed") or 0.0) / calib.bytes_per_s \
+            if calib.bytes_per_s else 0.0
+        compute_s += n * c
+        memory_s += n * m
+        device_s += n * max(c, m)
+        host_s += n * calib.host_s_per_dispatch
+    total_steps = max(1, total_steps)
+    comm_s = 0.0
+    if comm_bytes_per_step and calib.wire_bytes_per_s:
+        comm_s = comm_bytes_per_step / calib.wire_bytes_per_s
+    step_s = device_s / total_steps + host_s / total_steps + comm_s
+    breakdown = {"compute_s": compute_s / total_steps,
+                 "memory_s": memory_s / total_steps,
+                 "host_s": host_s / total_steps,
+                 "comm_s": comm_s}
+    bound = max(("compute", breakdown["compute_s"]),
+                ("memory", breakdown["memory_s"]),
+                ("host", breakdown["host_s"]),
+                ("comm", breakdown["comm_s"]),
+                key=lambda kv: kv[1])[0] if step_s > 0 else "unknown"
+    return {"step_s": step_s,
+            "steps_per_s": (1.0 / step_s) if step_s > 0 else None,
+            "bound": bound,
+            "breakdown": breakdown}
+
+
+def predict_from_profile(profile: Dict[str, Any],
+                         calib: Optional[Calibration] = None) -> Dict[str, Any]:
+    """Self-consistency probe: calibrate from ``profile`` (unless given) and
+    predict ITS OWN program mix, weighting each program by its dispatch
+    count. Returns the prediction plus ``measured_step_s`` and ``ratio``
+    (predicted/measured) — the agreement the tests pin within a generous
+    band, and the sanity check to run before trusting cross-plan ranking."""
+    calib = calib if calib is not None else calibrate(profile)
+    programs = profile.get("programs") or {}
+    summary = profile.get("summary") or {}
+    # One "plan unit" = every program, dispatch-weighted (predict() honors
+    # the records' own dispatch counts) so rare programs — a one-off eval
+    # signature — don't outvote the hot step.
+    out = predict(list(programs.values()), calib)
+    measured = summary.get("step_s")
+    out["measured_step_s"] = measured
+    out["ratio"] = (out["step_s"] / measured) \
+        if measured and out["step_s"] else None
+    out["calibration"] = calib.to_dict()
+    return out

@@ -40,13 +40,42 @@ from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
 
 __all__ = ["FlightRecorder", "set_recorder", "get_recorder", "get_or_create",
-           "maybe_record"]
+           "maybe_record", "build_manifest"]
 
 # Snapshot dir schema (pinned by tests): every snapshot contains exactly
 # these entries, so downstream tooling can rely on the layout.
 SNAPSHOT_FILES = ("manifest.json", "metrics.json", "events.jsonl",
                   "trace.json")
 _SNAP_PREFIX = "snap-"
+
+
+def build_manifest(reason: str, seq: Optional[int] = None) -> Dict[str, Any]:
+    """The shared environment manifest (who/when/where/with-what-flags) every
+    self-describing diagnostic artifact carries: flight-recorder snapshot
+    dirs AND the profiling plane's per-run profile JSONs
+    (:func:`autodist_tpu.telemetry.profiling.write_profile`) — so adprof can
+    say whether two profiles even came from comparable runs."""
+    import numpy as np
+    flags = {k: os.environ[k] for k in sorted(const.KNOWN_FLAGS)  # graftlint: disable=GL007(the manifest dumps the RAW env value of every SET registered flag — a whole-registry diagnostic snapshot, not a typed single-flag read)
+             if k in os.environ}
+    manifest: Dict[str, Any] = {
+        "reason": reason,
+        "t_wall_s": round(time.time(), 3),
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "process_id": const.ENV.AUTODIST_PROCESS_ID.val,
+        "flags": flags,
+        "versions": {"python": sys.version.split()[0],
+                     "numpy": np.__version__},
+    }
+    if seq is not None:
+        manifest["seq"] = seq
+    try:
+        import jax
+        manifest["versions"]["jax"] = jax.__version__
+    except Exception:   # jax-less diagnostics still snapshot
+        pass
+    return manifest
 
 
 def _sanitize(reason: str) -> str:
@@ -176,26 +205,8 @@ class FlightRecorder:
         return path
 
     def _write_manifest(self, path: str, reason: str, seq: int):
-        import numpy as np
-        flags = {k: os.environ[k] for k in sorted(const.KNOWN_FLAGS)  # graftlint: disable=GL007(the manifest dumps the RAW env value of every SET registered flag — a whole-registry diagnostic snapshot, not a typed single-flag read)
-                 if k in os.environ}
-        manifest: Dict[str, Any] = {
-            "reason": reason,
-            "seq": seq,
-            "t_wall_s": round(time.time(), 3),
-            "host": socket.gethostname(),
-            "pid": os.getpid(),
-            "process_id": const.ENV.AUTODIST_PROCESS_ID.val,
-            "flags": flags,
-            "versions": {"python": sys.version.split()[0],
-                         "numpy": np.__version__},
-            "files": list(SNAPSHOT_FILES),
-        }
-        try:
-            import jax
-            manifest["versions"]["jax"] = jax.__version__
-        except Exception:   # jax-less diagnostics still snapshot
-            pass
+        manifest = build_manifest(reason, seq=seq)
+        manifest["files"] = list(SNAPSHOT_FILES)
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
 

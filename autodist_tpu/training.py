@@ -17,6 +17,7 @@ from autodist_tpu import const, telemetry
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.runner import TrainState
 from autodist_tpu.telemetry import health as _health
+from autodist_tpu.telemetry import profiling as _profiling
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import ThroughputMeter
 
@@ -167,6 +168,16 @@ def train(runner, params: PyTree,
             unroll, type(runner).__name__)
 
     def _finish(final_state: TrainState) -> TrainState:
+        # End-of-run attribution flush (the health monitors' PR 8 contract,
+        # re-established here): a final partial period — steps not a
+        # multiple of log_every, or a run shorter than one period — still
+        # reaches the series; require_steps drops a dispatch-less tail.
+        # BEFORE the final save: a multi-second synchronous checkpoint
+        # would otherwise land in the tail period's compute residual and
+        # inflate the profile's period-weighted step_s.
+        if _profiling.active():
+            _profiling.observe_period(int(final_state.step),
+                                      require_steps=True)
         # Final save stays synchronous: train() returning means the state is
         # durably on disk (save() joins any in-flight periodic write first).
         if saver is not None and save_participant and int(final_state.step) > start:
@@ -174,6 +185,10 @@ def train(runner, params: PyTree,
                 saver.save(final_state, prefix_base, runner=runner)
         if saver is not None:
             saver.wait()
+        # Per-run profile store: with the attribution plane armed and
+        # AUTODIST_PROFILE_DIR set, the run's profile JSON (program costs +
+        # attribution series) lands on disk for adprof/costmodel.
+        _profiling.maybe_write_profile()
         return final_state
 
     if use_blocks:
@@ -215,6 +230,12 @@ def train(runner, params: PyTree,
             # local steps.
             rate = meter.step(sync=loss)
             if rate is not None:
+                # The period's attribution closes HERE — after the meter's
+                # boundary sync recorded its readback span, before the
+                # snapshot below is emitted — so the train.attr.*/mfu
+                # gauges it books describe exactly this period.
+                attr = _profiling.observe_period(step_i + 1) \
+                    if _profiling.active() else None
                 # Async-PS runs append their transport accounting (zero-copy
                 # wire counters) so per-period logs show parameter/gradient
                 # traffic next to throughput. `q` is the dispatch-ahead queue
@@ -225,10 +246,11 @@ def train(runner, params: PyTree,
                 stats = getattr(runner, "wire_stats", None)
                 stats = stats() if callable(stats) else None
                 logging.info("train: step %d loss %.4f %.1f examples/s "
-                             "| q 0 rb %.3fs%s",
+                             "| q 0 rb %.3fs%s%s",
                              step_i + 1, float(loss), rate,
                              meter.last_readback_s,
-                             f" | {stats.format_line()}" if stats else "")
+                             f" | {stats.format_line()}" if stats else "",
+                             _profiling.format_attr_line(attr))
                 if telemetry.enabled():
                     # Memory gauges first so the snapshot emitted below
                     # carries this boundary's live-buffer/HBM readings (and
@@ -324,7 +346,8 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
             return None
         if first_batch is None:
             first_batch = blk[0]
-        return runner.shard_block(blk)
+        with telemetry.span("runner.shard_block"):
+            return runner.shard_block(blk)
 
     meter = None
     step_i = start
@@ -351,14 +374,20 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
         if meter is not None:
             rate = meter.step_many(block.length, sync=losses)
             if rate is not None:
+                # Attribution closes at the same boundary the meter synced
+                # (readback span recorded), before emit_metrics ships the
+                # snapshot carrying the freshly-booked attr/mfu gauges.
+                attr = _profiling.observe_period(step_i) \
+                    if _profiling.active() else None
                 last = float(jax.device_get(losses)[-1])
                 # `q`: dispatch-ahead queue depth (0 means the host failed to
                 # stay ahead of the device — data-starved); `rb`: period
                 # seconds blocked on loss readback.
                 logging.info("train: step %d loss %.4f %.1f examples/s "
-                             "| q %d rb %.3fs",
+                             "| q %d rb %.3fs%s",
                              step_i, last, rate, queue_depth,
-                             meter.last_readback_s)
+                             meter.last_readback_s,
+                             _profiling.format_attr_line(attr))
                 if telemetry.enabled():
                     # Memory gauges first so the emitted snapshot carries
                     # this boundary's live-buffer/HBM readings (and the

@@ -13,42 +13,23 @@ chip's peak), from one of two estimators:
   (attention projections + score/value matmuls + MLP + vocab head, backward =
   2x forward). Used for the LM benches whose hot path is pallas.
 
-Peak FLOP/s comes from the device kind (bf16 peak), overridable with
-``AUTODIST_PEAK_FLOPS`` for new hardware.
+Peak FLOP/s comes from the shared peak-spec helper
+(:func:`autodist_tpu.telemetry.profiling.peak_spec` — device-kind tables
+plus the ``AUTODIST_PEAK_FLOPS``/``AUTODIST_PEAK_MEMBW`` overrides), the
+same source the roofline gauges divide by.
 """
 
 from typing import Optional
 
-# bf16 peak FLOP/s per chip by device_kind prefix (public spec sheets).
-_PEAK_BF16 = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 197e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,   # v6e (Trillium)
-    "TPU v6e": 918e12,
-}
-
 
 def device_peak_flops(device=None) -> Optional[float]:
-    """Per-device bf16 peak FLOP/s, or None when unknown (e.g. CPU)."""
-    from autodist_tpu import const
-    override = const.ENV.AUTODIST_PEAK_FLOPS.val
-    if override:
-        return float(override)
-    try:
-        import jax
-        device = device or jax.devices()[0]
-    except Exception:  # noqa: BLE001
-        return None
-    if device.platform == "cpu":
-        return None
-    kind = getattr(device, "device_kind", "") or ""
-    for prefix, peak in _PEAK_BF16.items():
-        if kind.startswith(prefix):
-            return peak
-    return None
+    """Per-device bf16 peak FLOP/s, or None when unknown (e.g. CPU).
+
+    Thin wrapper over the shared peak-spec helper so MFU reported here and
+    the profiling plane's ``train.mfu`` gauge can never disagree on the
+    denominator."""
+    from autodist_tpu.telemetry import profiling
+    return profiling.peak_spec(device).flops_per_s
 
 
 def _flops_from_cost(cost) -> Optional[float]:

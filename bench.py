@@ -28,6 +28,26 @@ def _baseline_path():
                         "PERF_BASELINE.json")
 
 
+def _append_trajectory(row: dict):
+    """Append one perf-history row to BENCH_TRAJECTORY.jsonl (repo root).
+
+    The BENCH_rNN.json artifacts are per-round snapshots that OVERWRITE each
+    other's story; this file is the append-only trajectory — one JSON line
+    per bench invocation (wall time, metric, rate, MFU, attribution shares
+    when the run measured them) so regressions are visible as a series, not
+    a pair. A write failure never breaks the bench (read-only checkouts run
+    it too)."""
+    import os
+    row = dict(row, t=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TRAJECTORY.jsonl")
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row, default=str) + "\n")
+    except OSError:
+        pass
+
+
 def legacy_wire_send(sock, obj):
     """The pre-zero-copy transport send, verbatim: full encode to one bytes
     object, header CONCAT, one sendall. The reference implementation of
@@ -402,6 +422,157 @@ def health_overhead(steps: int = 60, rounds: int = 3):
     except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
         pass  # a missing/mangled snapshot must not break the bench
     print(json.dumps(result))
+    return result
+
+
+def attr_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
+    """Performance-attribution plane cost micro-bench (the CPU transformer
+    micro-model, host-dispatch-bound — the shape class where per-dispatch
+    overhead is most visible):
+
+    - steps/s through ``runner.run`` with the attribution plane DISABLED
+      (production default: telemetry fully off) and ENABLED
+      (``profiling.enable()`` — span ring + per-dispatch signature/cost
+      accounting + a real ``observe_period`` boundary per round), best of
+      ``rounds`` interleaved rounds;
+    - the DIRECT enabled-side costs, machine-relative so they gate
+      everywhere: ``note_ns`` (one per-dispatch signature count) and
+      ``observe_ms`` (one log-boundary attribution pass over a
+      ``log_every``-step period's spans), combined as ``overhead_pct`` =
+      (note_ns + observe_ms/log_every) over the measured disabled step
+      time. This is the gated number: the ``attr_overhead`` row in
+      PERF_BASELINE.json carries ``max_overhead_pct`` (2.0) — attribution
+      growing past ~2%% of a host-bound step means the boundary join
+      stopped being a columnar-ring scan.
+
+    With ``AUTODIST_PROFILE_DIR`` set, the enabled run's profile JSON is
+    written there (the ci.sh adprof self-diff smoke reads it)."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry import profiling
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(loss_fn, params, optax.adam(1e-3),
+                                           example_batch=batch)
+    state = runner.init(params)
+
+    def measure(n, boundary=False):
+        nonlocal state
+        loss = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = runner.run(state, batch)
+        _ = jax.device_get(loss)   # completion fence
+        if boundary:
+            # The boundary work a real train() period pays, inside the
+            # timed window so the pair covers the WHOLE enabled cost.
+            profiling.observe_period()
+        return n / (time.perf_counter() - t0)
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    profiling.disable()
+    measure(10)                    # compile + warmup
+    profiling.enable()             # also enables spans
+    profiling.reset()
+    measure(3, boundary=True)
+    profiling.disable()
+    telemetry.disable()
+    best = {"disabled": 0.0, "enabled": 0.0}
+    for _ in range(rounds):        # interleaved: load noise hits both sides
+        best["disabled"] = max(best["disabled"], measure(steps))
+        profiling.enable()
+        best["enabled"] = max(best["enabled"], measure(steps, boundary=True))
+        profiling.disable()
+        telemetry.disable()
+
+    # Direct boundary cost: a log_every-step period's spans, one
+    # observe_period pass (min of rounds — load stretches, never shrinks).
+    profiling.enable()
+    observe_ms = math.inf
+    for _ in range(rounds):
+        measure(log_every)
+        t0 = time.perf_counter()
+        rec = profiling.observe_period()
+        observe_ms = min(observe_ms, (time.perf_counter() - t0) * 1e3)
+    shares = rec["shares"] if rec else None
+    mfu = rec.get("mfu") if rec else None
+    profile_path = profiling.maybe_write_profile()
+
+    # Direct per-dispatch cost of the signature count.
+    n_notes = 100_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n_notes):
+        profiling.note_dispatch("bench-sig", "step", 1)
+    note_ns = (time.perf_counter_ns() - t0) / n_notes
+    profiling.reset()
+    profiling.disable()
+    telemetry.clear()
+    if was_enabled:
+        telemetry.enable()
+    else:
+        telemetry.disable()
+
+    step_ns = 1e9 / best["disabled"]
+    overhead_pct = 100.0 * (note_ns + observe_ms * 1e6 / log_every) / step_ns
+
+    result = {
+        "metric": f"attr_overhead ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size}, "
+                  f"log_every {log_every})",
+        "unit": "steps/s",
+        "rows": {"disabled": round(best["disabled"], 2),
+                 "enabled": round(best["enabled"], 2)},
+        "enabled_vs_disabled": round(best["enabled"] / best["disabled"], 4),
+        "note_ns": round(note_ns, 1),
+        "observe_ms": round(observe_ms, 4),
+        "overhead_pct": round(overhead_pct, 4),
+        "attr": shares,
+    }
+    if profile_path:
+        result["profile"] = profile_path
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("attr_overhead")
+        if recorded:
+            max_pct = recorded.get("max_overhead_pct", 2.0)
+            if overhead_pct > max_pct:
+                print(f"WARNING: the attribution plane costs "
+                      f"{overhead_pct:.3f}% of a host-bound step, above the "
+                      f"{max_pct}% gate — per-dispatch counting or the "
+                      f"boundary span join got costlier (see "
+                      f"PERF_BASELINE.json attr_overhead)", file=sys.stderr)
+            floor = recorded.get("enabled_vs_disabled_floor")
+            if (floor and recorded.get("platform") == platform
+                    and result["enabled_vs_disabled"] < floor):
+                print(f"WARNING: attribution-enabled steps/s is "
+                      f"{result['enabled_vs_disabled']:.2f}x the disabled "
+                      f"rate, below the recorded {floor:.2f}x floor (see "
+                      f"PERF_BASELINE.json attr_overhead)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["disabled"],
+                        "unit": "steps/s", "mfu": mfu, "attr": shares,
+                        "overhead_pct": result["overhead_pct"]})
     return result
 
 
@@ -909,6 +1080,15 @@ def main(argv=None):
              "health_overhead row (enabled monitors must stay within 2%% "
              "of a host-bound step)")
     parser.add_argument(
+        "--attr-overhead", action="store_true",
+        help="measure the performance-attribution plane's cost on the CPU "
+             "micro-model: steps/s with profiling disabled vs enabled plus "
+             "the direct per-dispatch count and per-boundary attribution "
+             "costs, gated against max_overhead_pct in the "
+             "PERF_BASELINE.json attr_overhead row; writes the enabled "
+             "run's profile JSON into AUTODIST_PROFILE_DIR when set (the "
+             "adprof self-diff smoke reads it)")
+    parser.add_argument(
         "--trace-pull-overhead", action="store_true",
         help="measure the cluster trace plane's pull cost: fill the span "
              "ring to capacity, report the chief-side snapshot+encode stall "
@@ -944,6 +1124,9 @@ def main(argv=None):
         return
     if args.health_overhead:
         health_overhead()
+        return
+    if args.attr_overhead:
+        attr_overhead()
         return
     if args.trace_pull_overhead:
         trace_pull_overhead()
@@ -1057,6 +1240,36 @@ def main(argv=None):
     }
     if trace_dir is not None:
         result["profile_trace"] = trace_dir
+
+    # Attribution postscript — AFTER the timed loop, so the trajectory row
+    # can say where the step's wall time goes without taxing the reported
+    # rate: a short profiled window (3 steps + one observe_period). The
+    # analytic per-token count stands in for XLA's where the fused pallas
+    # head hides flops from cost analysis. Best-effort: a diagnostics
+    # postscript must never fail the flagship measurement.
+    attr = None
+    try:
+        from autodist_tpu import telemetry
+        from autodist_tpu.telemetry import profiling
+        was_on = telemetry.enabled()
+        profiling.enable()
+        profiling.reset()
+        profiling.set_analytic_flops(flops_per_token * tokens_per_step)
+        profiling.observe_period()        # open a clean window
+        for _ in range(3):
+            loss = step(batch)
+        _ = float(loss)
+        rec = profiling.observe_period()
+        attr = rec["shares"] if rec else None
+        profiling.reset()
+        profiling.disable()
+        if not was_on:
+            telemetry.disable()
+    except Exception:  # noqa: BLE001
+        pass
+    _append_trajectory({"metric": result["metric"], "value": result["value"],
+                        "unit": "tokens/s", "mfu": result["mfu"],
+                        "attr": attr})
     # Regression gate vs the recorded best (PERF_BASELINE.json): annotate the
     # JSON line and warn on stderr past the threshold. Round-over-round drift
     # was previously invisible (428.6k -> 425.8k went unremarked); this line

@@ -102,6 +102,17 @@ python bench.py --telemetry-overhead
 # Training-health monitor gate: the fused on-device numerics bundle must
 # stay within max_overhead_pct of a host-bound step (health_overhead row).
 python bench.py --health-overhead
+# Performance-attribution plane gate: per-dispatch cost counting plus the
+# log-boundary span join must stay within max_overhead_pct of a host-bound
+# step (attr_overhead row); the enabled run's profile JSON lands in the
+# smoke dir for the adprof self-diff below.
+ADPROF_SMOKE_DIR=$(mktemp -d)
+AUTODIST_PROFILE_DIR="$ADPROF_SMOKE_DIR" python bench.py --attr-overhead
+# adprof self-diff smoke: a profile diffed against itself must report zero
+# regressions (exit 0) — the CI-gating contract adprof's exit code carries.
+ADPROF_SMOKE=$(ls "$ADPROF_SMOKE_DIR"/profile-*.json | head -1)
+python tools/adprof.py "$ADPROF_SMOKE" "$ADPROF_SMOKE" --threshold 5
+rm -rf "$ADPROF_SMOKE_DIR"
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead

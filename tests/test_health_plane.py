@@ -337,11 +337,20 @@ def test_status_and_record_opcodes_over_loopback(tmp_path):
     try:
         remote._client.call("start_step", 0, 5.0)
         remote._client.call("finish_step", 0)
+        # Attribution-plane gauges ride the shared registry, so stats and
+        # status ship them with no transport change.
+        telemetry.gauge("train.mfu").set(0.28)
+        telemetry.gauge("train.attr.compute").set(0.61)
         status = remote.status()
         assert status["kind"] == "ps"
         assert status["staleness_bound"] == 2
         assert status["per_worker"][0]["lag"] == 0
         assert isinstance(status["events"], list)
+        # The PR 8 rename contract: `status` ships the event ring ONCE as
+        # `events` — re-aliasing it under `anomalies` would double the poll.
+        assert "anomalies" not in status
+        assert status["registry"]["train.mfu"] == 0.28
+        assert status["registry"]["train.attr.compute"] == 0.61
         json.dumps(status)                  # crossed the wire: plain data
         path = remote.record("operator_asked")
         assert path and os.path.isdir(path)
@@ -382,6 +391,9 @@ def _adtop():
 
 def test_adtop_once_renders_loopback_status(capsys):
     telemetry.gauge("train.health.grad_norm").set(2.5)
+    telemetry.gauge("train.mfu").set(0.283)
+    telemetry.gauge("train.attr.compute").set(0.61)
+    telemetry.gauge("train.attr.data_wait").set(0.07)
     telemetry.event("ps.anomaly.stall", worker=0, last_seen_s=42.0)
     server, addr = _loopback(watchdog=False)
     try:
@@ -393,6 +405,10 @@ def test_adtop_once_renders_loopback_status(capsys):
         assert "adtop — ps server" in out
         assert "w0" in out and "bound 2" in out
         assert "grad_norm 2.5" in out
+        # The attribution plane's roofline + phase-share gauges render on
+        # the perf line.
+        assert "mfu 28.3%" in out
+        assert "comp .61" in out and "data .07" in out
         assert "ps.anomaly.stall" in out
         # --raw ships the JSON payload verbatim.
         assert ad.main([addr, "--raw"]) == 0

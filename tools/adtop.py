@@ -5,9 +5,11 @@ Polls any PSServer or InferenceServer address over the ``status`` wire opcode
 and renders one screen: uptime and throughput counters, a per-worker table
 (last-seen age, instantaneous staleness lag, gate-entry lag histogram, wire
 traffic) for training endpoints, the queue/batch/in-flight-request table for
-serving endpoints, the ``train.health.*`` gauges when the health monitors are
-on, and the most recent anomaly events (watchdog stalls/stragglers, health
-NaN/spike records).
+serving endpoints, the attribution plane's ``train.mfu``/``train.membw_util``
+and ``train.attr.*`` phase-share gauges when profiling is on, the
+``train.health.*`` gauges when the health monitors are on, and the most
+recent anomaly events (watchdog stalls/stragglers, health NaN/spike
+records).
 
 Usage:
     python tools/adtop.py HOST:PORT                # live screen, 2s refresh
@@ -77,6 +79,27 @@ def _hist_quantile(hist: dict, q: float):
 def _counter(reg: dict, name: str):
     v = reg.get(name)
     return v if isinstance(v, (int, float)) else None
+
+
+def _perf_lines(reg: dict) -> list:
+    """The attribution plane's roofline + phase-share gauges, one line:
+    ``perf     mfu 28.3%  membw 41.2%  attr comp .61 comm .05 host .22
+    data .07 rb .05`` (only the gauges the run booked; the share rendering
+    is profiling.format_shares — the same one the train: log line uses)."""
+    from autodist_tpu.telemetry import profiling
+    head = []
+    mfu = reg.get("train.mfu")
+    if isinstance(mfu, (int, float)):
+        head.append(f"mfu {100.0 * mfu:.1f}%")
+    bw = reg.get("train.membw_util")
+    if isinstance(bw, (int, float)):
+        head.append(f"membw {100.0 * bw:.1f}%")
+    shares = {phase: reg.get(f"train.attr.{phase}")
+              for phase in profiling.ATTR_PHASES
+              if isinstance(reg.get(f"train.attr.{phase}"), (int, float))}
+    if shares:
+        head.append("attr " + profiling.format_shares(shares))
+    return ["perf     " + "  ".join(head)] if head else []
 
 
 def _health_lines(reg: dict) -> list:
@@ -174,6 +197,7 @@ def render(status: dict, address: str = "") -> str:
                              f"{_fmt_age(r.get('age_s', 0)):>5}  "
                              f"{r.get('tokens', 0):>6}  "
                              f"{r.get('prompt_len', 0):>6}")
+    lines.extend(_perf_lines(reg))
     lines.extend(_health_lines(reg))
     events = status.get("events") or status.get("anomalies") or []
     if events:
