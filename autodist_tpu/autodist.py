@@ -42,14 +42,30 @@ class AutoDist:
     """Entry point: resource spec + strategy builder -> distributed execution."""
 
     def __init__(self, resource_spec_file: Union[str, ResourceSpec, None] = None,
-                 strategy_builder: Optional[StrategyBuilder] = None):
+                 strategy_builder: Union[StrategyBuilder, str, None] = None):
         """``resource_spec_file``: YAML path, inline YAML text, an already-parsed
-        :class:`ResourceSpec`, or None for the local-devices default."""
+        :class:`ResourceSpec`, or None for the local-devices default.
+
+        ``strategy_builder``: a builder instance, or the string
+        ``"autotune"`` — the first ``create_distributed_session`` then runs
+        the plan autotuner (:mod:`autodist_tpu.strategy.autotune`) and
+        applies the winning builder + execution knobs (warm plan-cache
+        launches skip the search entirely)."""
         from autodist_tpu.strategy import PSLoadBalancing
         if isinstance(resource_spec_file, ResourceSpec):
             self._resource_spec = resource_spec_file
         else:
             self._resource_spec = ResourceSpec(resource_spec_file)
+        self._autotune = False
+        if isinstance(strategy_builder, str):
+            if strategy_builder != "autotune":
+                raise ValueError(
+                    f"unknown strategy name {strategy_builder!r}; the only "
+                    f"string strategy is 'autotune' (pass a StrategyBuilder "
+                    f"instance otherwise)")
+            self._autotune = True
+            strategy_builder = None
+        self._tuned_plan = None
         self._strategy_builder = strategy_builder or PSLoadBalancing()
         self._strategy: Optional[Strategy] = None
         self._compiled: Optional[Strategy] = None
@@ -173,7 +189,8 @@ class AutoDist:
                                    accumulation_steps: int = 1,
                                    batch_size: Optional[int] = None,
                                    zero: Optional[Any] = None,
-                                   health: Optional[bool] = None) -> DistributedRunner:
+                                   health: Optional[bool] = None,
+                                   tune: Optional[bool] = None) -> DistributedRunner:
         """Compile the strategy for this model and return the runner
         (reference autodist.py:191-198 returned the wrapped session).
 
@@ -200,7 +217,28 @@ class AutoDist:
         additionally emits the fused numerics bundle ``train()``'s monitors
         consume at log boundaries. See docs/usage/observability.md
         "Training health monitors".
+
+        ``tune`` runs the plan autotuner before the session is built
+        (default: ``AutoDist(strategy_builder="autotune")`` or the
+        ``AUTODIST_TUNE`` flag): the predict-prune-probe search
+        (:func:`autodist_tpu.strategy.autotune.autotune`) picks the builder
+        plus ``unroll``/``zero``/``accumulation_steps`` and this session
+        applies them — explicit ``zero``/``accumulation_steps`` arguments
+        win over the tuned values. The winner lands in the
+        ``AUTODIST_PLAN_CACHE`` file, so a warm relaunch of the same job
+        applies the tuned plan with zero search cost; the applied plan is
+        recorded in the profile/flight-recorder manifests and on
+        ``runner.tuned_plan`` (``train()`` adopts its ``unroll`` when none
+        is passed). See docs/usage/performance.md "Plan autotuning".
         """
+        self._maybe_autotune(tune, loss_fn, params, optimizer, example_batch,
+                             sparse_names, has_aux)
+        plan_knobs = self._tuned_plan
+        if plan_knobs is not None:
+            if accumulation_steps == 1:
+                accumulation_steps = plan_knobs.accumulation_steps
+            if zero is None and plan_knobs.zero:
+                zero = plan_knobs.zero
         model_spec = self._model_spec_for(loss_fn, params, example_batch, sparse_names)
         # Builders that model memory (AutoStrategy) get the session's optimizer
         # so regime decisions use exact state bytes, not an Adam-class guess.
@@ -237,13 +275,82 @@ class AutoDist:
                                    or (const.ENV.AUTODIST_PS_ADDR.val or None),
                                    zero=zero)
             runner._ps_listen_sock = getattr(self, "_ps_listen_sock", None)
+            runner.tuned_plan = self._tuned_plan
             self._session = runner  # _teardown closes its transport endpoints
             return runner
-        return DistributedRunner(compiled, model_spec, loss_fn, optimizer,
-                                 has_aux=has_aux, plan=plan,
-                                 accumulation_steps=accumulation_steps,
-                                 batch_size=batch_size, zero=zero,
-                                 health=health)
+        runner = DistributedRunner(compiled, model_spec, loss_fn, optimizer,
+                                   has_aux=has_aux, plan=plan,
+                                   accumulation_steps=accumulation_steps,
+                                   batch_size=batch_size, zero=zero,
+                                   health=health)
+        runner.tuned_plan = self._tuned_plan
+        return runner
+
+    def _maybe_autotune(self, tune: Optional[bool], loss_fn, params, optimizer,
+                        example_batch, sparse_names, has_aux):
+        """Run the plan autotuner once per instance (before the first
+        strategy build) and install the winning builder; later sessions on
+        this instance reuse the already-built strategy. No-ops off the
+        chief, without an example batch, or on multi-node specs (the search
+        measures locally — same contract as ``tune_strategy``)."""
+        if tune is None:
+            tune = self._autotune or const.ENV.AUTODIST_TUNE.val
+        if not tune or self._tuned_plan is not None:
+            return
+        if self._strategy is not None or self._compiled is not None:
+            logging.warning("AutoDist: tune requested after a strategy was "
+                            "already built; keeping the existing strategy")
+            return
+        if not self.is_chief:
+            return   # workers load the chief's strategy id as usual
+        import jax
+        if jax.process_count() > 1:
+            # A multi-process SPMD program must compile IDENTICAL step
+            # programs everywhere, but only the builder travels via the
+            # strategy id — a chief-tuned zero/unroll knob would diverge
+            # from the workers' defaults and wedge the collectives. Tune a
+            # single-process launch and ship the winning knobs explicitly.
+            logging.warning(
+                "AutoDist: tune=True in a multi-process SPMD program — the "
+                "tuned execution knobs (zero/unroll/accumulation) cannot "
+                "ship to the other processes, so the search is skipped; "
+                "tune single-process and pass the winning knobs explicitly")
+            return
+        if example_batch is None:
+            logging.warning("AutoDist: tune=True needs an example_batch to "
+                            "probe candidate plans; skipping the search")
+            return
+        if self._resource_spec.num_nodes > 1:
+            logging.warning(
+                "AutoDist: tune=True on a multi-node spec — the autotuner "
+                "measures on local devices only and would mis-rank "
+                "cross-node plans; skipping the search (tune on a "
+                "single-node spec and ship the winning builder)")
+            return
+        from autodist_tpu.strategy.autotune import autotune as _search
+        from autodist_tpu.telemetry import profiling as _profiling
+        try:
+            plan = _search(loss_fn, params, optimizer, example_batch,
+                           resource_spec=self._resource_spec,
+                           sparse_names=sparse_names, has_aux=has_aux)
+        except Exception as e:  # noqa: BLE001 — a failed search must degrade
+            # Same contract as the other skip paths above: tuning is an
+            # optimization, so a backend with no cost analysis (or every
+            # probe failing) falls back to the default builder with a
+            # warning instead of killing the launch.
+            logging.warning("AutoDist: plan autotune failed (%s: %s); "
+                            "keeping the default strategy builder",
+                            type(e).__name__, e)
+            return
+        self._tuned_plan = plan
+        self._strategy_builder = plan.make_builder()
+        # The applied plan travels with every diagnostic artifact: profile
+        # JSONs and flight-recorder manifests name which plan a run was
+        # executing (cache key + knobs + predicted vs measured).
+        _profiling.set_applied_plan(dict(plan.to_dict(), name=plan.name))
+        logging.info("AutoDist: applying tuned plan %s (%s)", plan.name,
+                     "cache hit" if plan.from_cache else
+                     f"searched in {plan.search_s:.2f}s")
 
     def _model_spec_for(self, loss_fn, params, example_batch, sparse_names) -> ModelSpec:
         if sparse_names is not None:
@@ -258,7 +365,8 @@ class AutoDist:
                  has_aux: bool = False, accumulation_steps: int = 1,
                  batch_size: Optional[int] = None,
                  zero: Optional[Any] = None,
-                 health: Optional[bool] = None) -> Callable:
+                 health: Optional[bool] = None,
+                 tune: Optional[bool] = None) -> Callable:
         """TF2-style stepping: returns ``step(batch) -> loss`` carrying state
         internally (reference autodist.py:252-289 cached a built runner the same
         way: first call builds, later calls reuse).
@@ -270,7 +378,7 @@ class AutoDist:
         runner = self.create_distributed_session(
             loss_fn, params, optimizer, example_batch, sparse_names, has_aux,
             accumulation_steps=accumulation_steps, batch_size=batch_size,
-            zero=zero, health=health)
+            zero=zero, health=health, tune=tune)
         state = runner.init(params)
 
         def step(batch, fetches=None):

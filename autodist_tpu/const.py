@@ -107,6 +107,16 @@ KNOWN_FLAGS = {
                             "and diffs these)",
     "AUTODIST_PEAK_MEMBW": "per-device peak HBM bytes/s override for the "
                            "membw_util roofline gauge (peak-spec helper)",
+    "AUTODIST_TUNE": "plan autotuner: create_distributed_session searches "
+                     "the strategy x execution-knob space (predict-prune-"
+                     "probe) and applies the winner",
+    "AUTODIST_PLAN_CACHE": "path of the persistent plan-cache JSON file; a "
+                           "warm entry applies the tuned plan with zero "
+                           "probe steps (empty = no persistence)",
+    "AUTODIST_TUNE_TOPK": "autotuner stage-2 budget: at most this many "
+                          "stage-1 survivors are measured with real steps",
+    "AUTODIST_TUNE_BUDGET": "autotuner stage-1 budget: cap on enumerated "
+                            "candidates ranked by the calibrated cost model",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -219,6 +229,16 @@ _ENV_DEFAULTS = {
     "AUTODIST_PROFILE": False,
     "AUTODIST_PROFILE_DIR": "",
     "AUTODIST_PEAK_MEMBW": "",
+    # Plan autotuner (autodist_tpu/strategy/autotune.py): predict-prune-probe
+    # search over the strategy x {unroll, zero, accumulation, overlap} space,
+    # ranked by the calibrated cost model (telemetry/costmodel.py) and
+    # settled by a few real steps for the top-k survivors; the winner
+    # persists in the plan-cache file so later launches of the same
+    # (model, topology, version) skip the search entirely.
+    "AUTODIST_TUNE": False,
+    "AUTODIST_PLAN_CACHE": "",
+    "AUTODIST_TUNE_TOPK": 3,
+    "AUTODIST_TUNE_BUDGET": 32,
 }
 
 class ENV(enum.Enum):
@@ -264,6 +284,10 @@ class ENV(enum.Enum):
     AUTODIST_PROFILE = "AUTODIST_PROFILE"
     AUTODIST_PROFILE_DIR = "AUTODIST_PROFILE_DIR"
     AUTODIST_PEAK_MEMBW = "AUTODIST_PEAK_MEMBW"
+    AUTODIST_TUNE = "AUTODIST_TUNE"
+    AUTODIST_PLAN_CACHE = "AUTODIST_PLAN_CACHE"
+    AUTODIST_TUNE_TOPK = "AUTODIST_TUNE_TOPK"
+    AUTODIST_TUNE_BUDGET = "AUTODIST_TUNE_BUDGET"
 
     @property
     def val(self):

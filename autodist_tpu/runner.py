@@ -266,14 +266,10 @@ class DistributedRunner:
         params = self.plan.pad_params(params)
         opt_state = self._optimizer.init(params)
         ef_state = synchronization.init_ef_state(self.plan, params, mesh=self.mesh)
-        p_sh = self.plan.param_sharding_tree(self.mesh, params)
-        o_sh = self.plan.opt_sharding_tree(self.mesh, opt_state)
-        e_sh = synchronization.ef_sharding_tree(self.mesh, ef_state)
-        self._state_shardings = TrainState(
-            step=NamedSharding(self.mesh, P()), params=p_sh, opt_state=o_sh,
-            ef_state=e_sh, plan=self.plan)
         state = TrainState(step=np.zeros((), np.int32), params=params,
                            opt_state=opt_state, ef_state=ef_state, plan=self.plan)
+        self._state_shardings = None   # rebuild for THIS init's trees
+        self._ensure_state_shardings(state)
         # Jitted identity with out_shardings: places the state on the mesh AND
         # guarantees fresh buffers (a plain device_put may alias caller-owned arrays,
         # which step donation would then delete out from under the caller).
@@ -773,6 +769,103 @@ class DistributedRunner:
                     compile_s=compile_s)
         return _CompileProbe(telemetry.span(
             "jit.compile", kind=kind, sig=digest, **span_args), cost_cb)
+
+    # ------------------------------------------------- compile-only cost probe
+    def _abstract_state(self, params: PyTree) -> TrainState:
+        """The :class:`TrainState` this runner's ``init(params)`` would build,
+        as a ``ShapeDtypeStruct`` pytree via ``jax.eval_shape`` — no device
+        allocation, no dispatch. The probe path's stand-in for real state."""
+        import jax.numpy as jnp
+
+        def build(p):
+            p = self.plan.pad_params(p)
+            opt_state = self._optimizer.init(p)
+            ef_state = synchronization.init_ef_state(self.plan, p)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                              opt_state=opt_state, ef_state=ef_state,
+                              plan=self.plan)
+
+        return jax.eval_shape(build, params)
+
+    def _ensure_state_shardings(self, state: TrainState):
+        """Derive the jit in/out shardings from a (possibly abstract) state
+        tree — the ONE sharding-tree construction, shared by ``init``
+        (concrete trees) and the compile-only probe (ShapeDtypeStructs; the
+        derivation only reads leaf paths), so the probe can never lower a
+        program with different shardings than the real run."""
+        if self._state_shardings is not None:
+            return
+        self._state_shardings = TrainState(
+            step=NamedSharding(self.mesh, P()),
+            params=self.plan.param_sharding_tree(self.mesh, state.params),
+            opt_state=self.plan.opt_sharding_tree(self.mesh, state.opt_state),
+            ef_state=synchronization.ef_sharding_tree(self.mesh,
+                                                      state.ef_state),
+            plan=self.plan)
+
+    def _abstract_batch(self, batch: PyTree, block: int = 0) -> PyTree:
+        """The ShapeDtypeStruct mirror of ``shard_batch`` (``block=0``) /
+        ``shard_block`` (``block=K``)'s layout — same micro-batch wrapping and
+        leading axes, no placement. Feeds :meth:`plan_costs`' lowering."""
+        dp = synchronization.mesh_dp_size(self.mesh)
+        k = self._accum
+        batch_dim = self._micro_batch_dim(batch, k, dp)
+
+        def abs_leaf(leaf):
+            micro = _is_micro(leaf)
+            if micro:
+                leaf = leaf.value           # already laid out [k, B/k, ...]
+            arr = leaf if hasattr(leaf, "shape") else np.asarray(leaf)
+            shape, dtype = tuple(arr.shape), np.dtype(arr.dtype)
+            if (not micro and k > 1 and len(shape) >= 1
+                    and shape[0] == batch_dim):
+                self._require_micro_divisible(shape[0], k, dp)
+                shape = (k, shape[0] // k) + shape[1:]
+                micro = True
+            if block:
+                shape = (block,) + shape
+            struct = jax.ShapeDtypeStruct(shape, dtype)
+            return MicroBatched(struct) if micro else struct
+
+        return jax.tree_util.tree_map(abs_leaf, batch, is_leaf=_is_micro)
+
+    def plan_costs(self, params: PyTree, example_batch: PyTree,
+                   unroll: int = 1) -> Optional[dict]:
+        """Compile-only static cost probe of this runner's step program.
+
+        Lowers + compiles the (``unroll=K`` fused or single-step) training
+        program at abstract args — state via :meth:`_abstract_state`, batch
+        via :meth:`_abstract_batch` — and returns XLA's cost analysis as a
+        ``{"flops", "bytes_accessed", "output_bytes", "steps", "dispatches",
+        "source"}`` record (flops/bytes PER DISPATCH, the shape
+        ``telemetry.costmodel.predict`` consumes), or None when the backend
+        reports nothing. **No step executes and no state is allocated**: the
+        probe's only cost is one compilation, which lands in jit's executable
+        cache so a later real first step of the same signature reuses it.
+        This is the predict-stage interface the plan autotuner
+        (:mod:`autodist_tpu.strategy.autotune`) ranks candidates with."""
+        if unroll < 1:
+            raise ValueError("unroll must be >= 1")
+        if unroll > 1 and not self.supports_run_many:
+            raise RuntimeError(
+                f"{type(self).__name__} has no fused multi-step program to "
+                f"probe at unroll={unroll}; probe unroll=1")
+        state = self._abstract_state(params)
+        self._ensure_state_shardings(state)
+        if unroll > 1:
+            jitted = self._many_fns.get(None)
+            if jitted is None:
+                jitted = self._build_many(None)
+            batch = self._abstract_batch(example_batch, block=unroll)
+        else:
+            jitted = self._step_fns.get(None)
+            if jitted is None:
+                jitted = self._build_step(None)
+            batch = self._abstract_batch(example_batch)
+        cost = self._extract_program_cost(jitted, (state, batch), steps=unroll)
+        if cost is None:
+            return None
+        return dict(cost, steps=unroll, dispatches=1, source="xla")
 
     def logical_params(self, state_or_params) -> PyTree:
         """The parameter tree at its original (user-facing, unpadded) shapes."""

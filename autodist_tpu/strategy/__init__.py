@@ -20,7 +20,11 @@ from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
 from autodist_tpu.strategy.pipeline_strategy import Pipeline
 from autodist_tpu.strategy.sequence_parallel_strategy import SequenceParallel
 from autodist_tpu.strategy.auto_strategy import AutoStrategy
-from autodist_tpu.strategy.tuner import TuneResult, tune_strategy
+from autodist_tpu.strategy.tuner import (CandidateResult, TuneResult,
+                                         measure_candidate, tune_strategy)
+from autodist_tpu.strategy.autotune import (Candidate, TunedPlan, autotune,
+                                            enumerate_candidates,
+                                            plan_cache_key)
 
 __all__ = [
     "Strategy", "StrategyBuilder", "StrategyCompiler",
@@ -28,4 +32,7 @@ __all__ = [
     "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
     "RandomAxisPartitionAR", "Parallax", "ExpertParallel", "Pipeline",
     "SequenceParallel", "AutoStrategy", "tune_strategy", "TuneResult",
+    "measure_candidate", "CandidateResult",
+    "autotune", "TunedPlan", "Candidate", "enumerate_candidates",
+    "plan_cache_key",
 ]

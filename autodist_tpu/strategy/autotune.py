@@ -1,0 +1,747 @@
+"""Telemetry-calibrated plan autotuner: predict, prune, probe, persist.
+
+ROADMAP item 3 (Automap, arXiv 2112.02958; weight-update sharding, arXiv
+2004.13336): the win at scale comes from *searching* the joint
+strategy x execution-knob space with a calibrated cost model, not from
+hand-picking one builder. This module unifies the repo's three previously
+disconnected pieces into one two-stage search:
+
+- :class:`AutoStrategy`'s analytic regime/partition rules **generate
+  candidates** (PS vs collective vs partitioned variants) instead of one
+  answer, jointly with the execution knobs the runtime already ships:
+  ``unroll=K`` (PR 1), ``zero`` weight-update sharding (PR 6),
+  ``accumulation_steps``, and the async-PS client's ``overlap``;
+- **Stage 1 (predict + prune)** ranks every candidate with
+  :func:`telemetry.costmodel.predict` fed by compile-only static costs from
+  the runner's :meth:`DistributedRunner.plan_costs` probe (lower + XLA
+  ``cost_analysis()`` — **no step executes**), using a
+  :class:`~autodist_tpu.telemetry.costmodel.Calibration` loaded from an
+  ``AUTODIST_PROFILE_DIR`` profile or the bundled default; candidates whose
+  predicted step time exceeds the frontrunner by a margin are pruned without
+  ever being measured.
+- **Stage 2 (probe)** runs a few real steps for the top-k survivors through
+  the tuner's shared :func:`~autodist_tpu.strategy.tuner.measure_candidate`
+  loop (failure-skip semantics preserved), and the measured winner persists
+  to the on-disk **plan cache** (``AUTODIST_PLAN_CACHE``, schema-versioned
+  JSON keyed by model/shape signature + device topology + package version)
+  so later launches of the same job apply the tuned plan with zero search
+  cost.
+
+``AutoDist.create_distributed_session(..., tune=True)`` /
+``AutoDist(strategy_builder="autotune")`` is the user entry; every search
+emits ``tune.*`` telemetry spans/gauges and an :meth:`TunedPlan.explain`
+table so adprof/adtop can show why a plan won.
+"""
+
+import dataclasses
+import gc
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from autodist_tpu import const, telemetry
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import StrategyBuilder, num_devices
+from autodist_tpu.strategy.tuner import CandidateResult, measure_candidate
+from autodist_tpu.telemetry import costmodel
+from autodist_tpu.utils import logging
+
+__all__ = ["Candidate", "TunedPlan", "autotune", "enumerate_candidates",
+           "plan_cache_key", "load_cached_plan", "store_plan",
+           "DEFAULT_CALIBRATION", "PLAN_SCHEMA", "PLAN_SCHEMA_VERSION"]
+
+# Plan/plan-cache JSON identity, pinned by tests. Bump on breaking change.
+PLAN_SCHEMA = "autodist-plan-cache"
+PLAN_SCHEMA_VERSION = 1
+
+# The unroll factors stage 1 ranks by default: the PR 1 sweep's grid (the
+# measured curve flattens at 8 on host-bound models, PERF_BASELINE
+# unroll_curve).
+DEFAULT_UNROLLS = (1, 2, 4, 8)
+
+# Stage-1 prune margin: a candidate predicted more than this fraction slower
+# than the frontrunner is dropped without measurement. Wide by design — the
+# calibrated model ranks, it does not referee photo finishes; anything
+# within 35% of the leader deserves a real probe (subject to top-k).
+PRUNE_MARGIN = 0.35
+
+# Bundled default calibration, used when no AUTODIST_PROFILE_DIR profile is
+# available. Provenance (this matters: the ABSOLUTE numbers are generic, the
+# STRUCTURE — host cost per dispatch >> 0, finite device rates, a measured
+# wire — is what makes the ranking sane):
+# - host_s_per_dispatch 2e-3: the dev box's host-bound CPU micro-step is
+#   ~9 ms (PERF_BASELINE attr_overhead) of which the host share dominates;
+#   2 ms/dispatch is the order profiling measured — this term is what makes
+#   unroll=K amortization win on host-bound models.
+# - flops_per_s 5e10 / bytes_per_s 5e9: CPU-class achieved rates (a few
+#   GFLOP/s/core x a few cores), so big programs still cost more than small
+#   ones; on TPU, calibrate from a real profile instead.
+# - wire_bytes_per_s 400e6: the measured zero-copy PS wire rate
+#   (PERF_BASELINE ps_wire zero_copy, MB/s) — the comm term for async-PS
+#   candidates.
+DEFAULT_CALIBRATION = costmodel.Calibration(
+    flops_per_s=5e10, bytes_per_s=5e9, host_s_per_dispatch=2e-3,
+    wire_bytes_per_s=400e6)
+
+# Builders the autotuner may emit, by name — the reconstructible subset a
+# cached plan can name (cache entries store a spec, not a pickle).
+_BUILDERS: Dict[str, Callable[..., StrategyBuilder]] = {}
+
+
+def _builder_registry() -> Dict[str, Callable[..., StrategyBuilder]]:
+    if not _BUILDERS:
+        from autodist_tpu.strategy import (AllReduce, AutoStrategy, Parallax,
+                                           PartitionedAR, PartitionedPS, PS,
+                                           PSLoadBalancing)
+        _BUILDERS.update({
+            "AllReduce": AllReduce, "PSLoadBalancing": PSLoadBalancing,
+            "AutoStrategy": AutoStrategy, "Parallax": Parallax,
+            "PartitionedAR": PartitionedAR, "PartitionedPS": PartitionedPS,
+            "PS": PS,
+        })
+    return _BUILDERS
+
+
+def builder_from_spec(spec: Dict[str, Any]) -> StrategyBuilder:
+    """Reconstruct a builder from its cacheable ``{"name", "kwargs"}`` spec."""
+    reg = _builder_registry()
+    name = spec.get("name")
+    if name not in reg:
+        raise ValueError(f"unknown builder {name!r} in plan spec (known: "
+                         f"{sorted(reg)}); the plan cache may predate this "
+                         f"package version")
+    return reg[name](**(spec.get("kwargs") or {}))
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One point of the joint strategy x knob space."""
+
+    builder_spec: Dict[str, Any]          # {"name": ..., "kwargs": {...}}
+    unroll: int = 1
+    accumulation_steps: int = 1
+    zero: int = 0
+    overlap: bool = True                  # async-PS prefetch client knob
+    asynchronous: bool = False            # async regime: predicted, not probed
+    why: str = ""                         # enumeration reason
+    predicted: Optional[Dict[str, Any]] = None   # costmodel.predict output
+    pruned: Optional[str] = None          # prune reason, None = survivor
+    probe: Optional[CandidateResult] = None      # stage-2 measurement
+
+    @property
+    def name(self) -> str:
+        knobs = []
+        if self.unroll != 1:
+            knobs.append(f"unroll={self.unroll}")
+        if self.accumulation_steps != 1:
+            knobs.append(f"accum={self.accumulation_steps}")
+        if self.zero:
+            knobs.append(f"zero={self.zero}")
+        if self.asynchronous:
+            knobs.append("async" + ("" if self.overlap else ",overlap=0"))
+        base = self.builder_spec["name"]
+        return f"{base}[{','.join(knobs)}]" if knobs else base
+
+    def make_builder(self) -> StrategyBuilder:
+        return builder_from_spec(self.builder_spec)
+
+    def base_key(self) -> Tuple:
+        """The compile-probe grouping key: candidates differing only in
+        ``unroll``/``overlap`` share one probed base program (the fused
+        block's cost is the scanned body's x K — the same scaling rule the
+        runner's cost extraction already applies)."""
+        return (self.builder_spec["name"],
+                tuple(sorted((self.builder_spec.get("kwargs") or {}).items())),
+                self.accumulation_steps, self.zero, self.asynchronous)
+
+
+@dataclasses.dataclass
+class TunedPlan:
+    """The autotuner's product: winning knobs + the evidence.
+
+    ``to_dict()``/``from_dict()`` round-trip through the plan cache;
+    ``candidates`` (search runs only) carries the full enumerated record
+    behind :meth:`explain`."""
+
+    builder_spec: Dict[str, Any]
+    unroll: int = 1
+    accumulation_steps: int = 1
+    zero: int = 0
+    overlap: bool = True
+    predicted: Optional[Dict[str, Any]] = None
+    measured_steps_per_s: Optional[float] = None
+    cache_key: str = ""
+    from_cache: bool = False
+    search_s: float = 0.0
+    enumerated: int = 0
+    probed: int = 0
+    candidates: List[Candidate] = dataclasses.field(default_factory=list)
+
+    def make_builder(self) -> StrategyBuilder:
+        return builder_from_spec(self.builder_spec)
+
+    @property
+    def name(self) -> str:
+        c = Candidate(self.builder_spec, unroll=self.unroll,
+                      accumulation_steps=self.accumulation_steps,
+                      zero=self.zero, overlap=self.overlap)
+        return c.name
+
+    def knobs_dict(self) -> Dict[str, Any]:
+        return {"builder": self.builder_spec, "unroll": self.unroll,
+                "accumulation_steps": self.accumulation_steps,
+                "zero": self.zero, "overlap": self.overlap}
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The cache entry / profile-manifest record: knobs + prediction +
+        measurement + provenance (schema-versioned at the cache file level)."""
+        return {
+            "cache_key": self.cache_key,
+            "knobs": self.knobs_dict(),
+            "predicted": self.predicted,
+            "measured_steps_per_s": self.measured_steps_per_s,
+            "search_s": round(self.search_s, 3),
+            "enumerated": self.enumerated,
+            "probed": self.probed,
+            "from_cache": self.from_cache,
+            "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TunedPlan":
+        knobs = d.get("knobs") or {}
+        return cls(builder_spec=knobs.get("builder") or {"name": "AllReduce"},
+                   unroll=int(knobs.get("unroll") or 1),
+                   accumulation_steps=int(knobs.get("accumulation_steps") or 1),
+                   zero=int(knobs.get("zero") or 0),
+                   overlap=bool(knobs.get("overlap", True)),
+                   predicted=d.get("predicted"),
+                   measured_steps_per_s=d.get("measured_steps_per_s"),
+                   cache_key=d.get("cache_key") or "",
+                   search_s=float(d.get("search_s") or 0.0),
+                   enumerated=int(d.get("enumerated") or 0),
+                   probed=int(d.get("probed") or 0))
+
+    def explain(self) -> str:
+        """Why this plan won: one row per enumerated candidate — predicted
+        step time + binding resource from stage 1, measured steps/s or the
+        prune/skip reason from stage 2 — ranked by prediction, winner
+        marked. A cache-hit plan explains itself from the stored record."""
+        if not self.candidates:
+            src = "plan cache" if self.from_cache else "search"
+            pred = (f"predicted {self.predicted['step_s'] * 1e3:.3f} ms/step "
+                    f"({self.predicted.get('bound')}-bound)"
+                    if self.predicted else "no prediction")
+            meas = (f"measured {self.measured_steps_per_s:.2f} steps/s"
+                    if self.measured_steps_per_s else "unmeasured")
+            return (f"{self.name}  <- applied from {src} "
+                    f"[{self.cache_key}]\n  {pred}; {meas}")
+        rows = sorted(self.candidates,
+                      key=lambda c: (c.predicted or {}).get("step_s")
+                      or float("inf"))
+        width = max(len(c.name) for c in rows)
+        lines = [f"autotune [{self.cache_key}]: {self.enumerated} candidates, "
+                 f"{self.probed} probed, {self.search_s:.2f}s search"]
+        for c in rows:
+            pred = c.predicted or {}
+            p = (f"{pred['step_s'] * 1e3:9.3f} ms/step {pred['bound']:>7}"
+                 if pred.get("step_s") is not None else
+                 f"{'?':>9} ms/step {'?':>7}")
+            if c.probe is not None and c.probe.steps_per_sec is not None:
+                tail = f"measured {c.probe.steps_per_sec:8.2f} steps/s"
+                if (c.builder_spec == self.builder_spec
+                        and c.unroll == self.unroll
+                        and c.accumulation_steps == self.accumulation_steps
+                        and c.zero == self.zero):
+                    tail += "  <- winner"
+            elif c.probe is not None:
+                tail = f"probe: {c.probe.error}"
+            elif c.pruned:
+                tail = f"pruned: {c.pruned}"
+            else:
+                tail = "not probed"
+            lines.append(f"  {c.name:<{width}}  {p}  {tail}")
+        return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ cache key
+
+def plan_cache_key(model_spec, example_batch: Any = None,
+                   resource_spec: Optional[ResourceSpec] = None) -> str:
+    """The cache identity of one tuning problem: model/shape signature
+    (trainable param names, shapes, dtypes, sparsity) + batch leaf
+    shapes/dtypes + device topology (platform, device kind, local device
+    count, process count, resource-spec node count) + package version.
+    Any of these changing invalidates by MISS — old entries stay valid for
+    the jobs they were tuned for."""
+    import numpy as np
+    from autodist_tpu.version import __version__
+    parts: List[str] = [f"v{__version__}"]
+    try:
+        import jax
+        dev = jax.devices()[0]
+        parts.append(f"{dev.platform}:{getattr(dev, 'device_kind', '')}"
+                     f":d{len(jax.devices())}:p{jax.process_count()}")
+    except Exception:  # noqa: BLE001 — key must be computable backend-less
+        parts.append("nojax")
+    if resource_spec is not None:
+        parts.append(f"nodes{resource_spec.num_nodes}")
+    for name, p in sorted(model_spec.trainable.items()):
+        parts.append(f"{name}:{tuple(p.shape)}:{p.dtype}"
+                     f":{'s' if p.sparse else 'd'}")
+    if example_batch is not None:
+        try:
+            import jax
+            leaves = jax.tree_util.tree_leaves(example_batch)
+        except Exception:  # noqa: BLE001 — same backend-less contract as above
+            leaves = []
+            parts.append("nobatch")
+        for leaf in leaves:
+            arr = leaf if hasattr(leaf, "shape") else np.asarray(leaf)
+            parts.append(f"b{tuple(arr.shape)}:{arr.dtype}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _read_cache_file(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if (doc.get("schema") != PLAN_SCHEMA
+            or doc.get("schema_version") != PLAN_SCHEMA_VERSION):
+        logging.warning("plan cache %s has schema %r v%r (want %s v%d); "
+                        "ignoring it", path, doc.get("schema"),
+                        doc.get("schema_version"), PLAN_SCHEMA,
+                        PLAN_SCHEMA_VERSION)
+        return {}
+    return doc if isinstance(doc.get("plans"), dict) else {}
+
+
+def load_cached_plan(path: str, key: str) -> Optional[TunedPlan]:
+    """The cached :class:`TunedPlan` for ``key``, or None (missing file,
+    wrong schema, unknown key, or an entry naming a builder this version
+    cannot reconstruct — all misses, never errors)."""
+    if not path:
+        return None
+    entry = _read_cache_file(path).get("plans", {}).get(key)
+    if not entry:
+        return None
+    plan = TunedPlan.from_dict(entry)
+    plan.cache_key = key
+    plan.from_cache = True
+    try:
+        plan.make_builder()   # entry must be reconstructible to count as a hit
+    except ValueError as e:
+        logging.warning("plan cache %s[%s]: %s; treating as a miss", path,
+                        key, e)
+        return None
+    return plan
+
+
+def store_plan(path: str, plan: TunedPlan) -> bool:
+    """Persist ``plan`` under its key (read-modify-write; a fresh or corrupt
+    file is recreated). Returns True on success — a failed write logs and
+    returns False, a broken disk never takes down the run being tuned.
+
+    The read-modify-write runs under an ``flock`` on a sidecar lock file, so
+    two jobs finishing searches against a shared cache merge their entries
+    instead of the later ``os.replace`` silently erasing the earlier job's
+    plan (which would re-run its full search on every warm launch). The
+    rename stays atomic for lock-less readers."""
+    if not path:
+        return False
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(f"{path}.lock", "a") as lock:
+            try:
+                import fcntl
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass   # no flock (non-POSIX / odd fs): best-effort write
+            doc = _read_cache_file(path)
+            if not doc:
+                doc = {"schema": PLAN_SCHEMA,
+                       "schema_version": PLAN_SCHEMA_VERSION, "plans": {}}
+            doc["plans"][plan.cache_key] = plan.to_dict()
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)  # atomic: concurrent readers see old or new
+        return True
+    except OSError as e:
+        logging.warning("plan cache write to %s failed: %s", path, e)
+        return False
+
+
+# ---------------------------------------------------------------- enumeration
+
+def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
+                         optimizer=None, *,
+                         unrolls: Sequence[int] = DEFAULT_UNROLLS,
+                         accums: Sequence[int] = (1,),
+                         include_async: Optional[bool] = None,
+                         budget: Optional[int] = None) -> List[Candidate]:
+    """The joint candidate space, generated from :class:`AutoStrategy`'s
+    analytic rules instead of collapsed to its one answer:
+
+    - **regime**: AllReduce and (sync) PSLoadBalancing always compete;
+      memory pressure (resident params + exact optimizer-state bytes vs the
+      per-device budget — AutoStrategy's rule) additionally admits the
+      async-PS regime with the ``overlap`` knob on/off;
+    - **sparse**: any sparse parameter admits Parallax (the sparse-wire
+      rule); **partitioning**: any dense parameter above the partition
+      threshold with a partitionable axis admits PartitionedAR (and
+      PartitionedPS when memory-bound);
+    - **knobs**: each builder crosses ``unroll`` (sync only — the async
+      regime has no fused block), ``accumulation_steps``, and ``zero``
+      (only where the mesh has a data-parallel extent to shard over).
+
+    Deterministic order (builder priority, then unroll/accum/zero
+    ascending), capped at ``budget`` (``AUTODIST_TUNE_BUDGET``) with a log
+    line naming how many were dropped — a silent cap would read as
+    "searched everything" when it didn't."""
+    from autodist_tpu.strategy.auto_strategy import (_device_memory_budget,
+                                                     _opt_state_bytes)
+    from autodist_tpu.strategy.partition_utils import partitionable_axis
+
+    if budget is None:
+        budget = int(const.ENV.AUTODIST_TUNE_BUDGET.val)
+    n_dev = num_devices(resource_spec)
+    dense = {n: s for n, s in model_spec.trainable.items() if not s.sparse}
+    has_sparse = len(dense) != len(model_spec.trainable)
+    dense_bytes = sum(s.byte_size for s in dense.values())
+    opt_bytes = _opt_state_bytes(optimizer, model_spec, dense) \
+        if optimizer is not None else None
+    state_bytes = (dense_bytes + opt_bytes) if opt_bytes is not None \
+        else 3 * dense_bytes
+    budget_bytes = _device_memory_budget()
+    memory_bound = state_bytes > budget_bytes
+    partitioned = [s for s in dense.values()
+                   if s.byte_size >= 64 << 20
+                   and partitionable_axis(s) is not None]
+    if include_async is None:
+        include_async = memory_bound
+
+    bases: List[Tuple[Dict[str, Any], bool, str]] = [
+        ({"name": "AllReduce"}, False, "dense collective baseline"),
+        ({"name": "PSLoadBalancing"}, False, "sync PS (the session default)"),
+    ]
+    if has_sparse:
+        bases.append(({"name": "Parallax"}, False,
+                      "sparse params ride the sparse wire"))
+    if partitioned and n_dev > 1:
+        bases.append(({"name": "PartitionedAR"}, False,
+                      f"{len(partitioned)} param(s) above the partition "
+                      f"threshold"))
+        if memory_bound:
+            bases.append(({"name": "PartitionedPS"}, False,
+                          "partitioned + memory-bound"))
+    if include_async:
+        why = ("resident state exceeds the per-device budget"
+               if memory_bound else "async regime requested")
+        bases.append(({"name": "PS", "kwargs": {"sync": False}}, True, why))
+
+    # The zero knob only changes the program where the spec's mesh has a
+    # data-parallel extent to shard over — gated on the SAME device count
+    # the partition gate reads, so a spec pinning one device never wastes
+    # compile probes (or top-k slots) on zero=1 twins of zero=0 programs.
+    zeros = [0, 1] if n_dev > 1 else [0]
+    out: List[Candidate] = []
+    for spec, is_async, why in bases:
+        for accum in accums:
+            for zero in zeros:
+                if is_async:
+                    # The async regime has no fused block and its ZeRO knob
+                    # (server-side apply shards) changes no device program;
+                    # the client overlap knob is its execution dimension.
+                    if zero:
+                        continue
+                    for overlap in (True, False):
+                        out.append(Candidate(
+                            spec, unroll=1, accumulation_steps=accum,
+                            zero=0, overlap=overlap, asynchronous=True,
+                            why=why))
+                    continue
+                for unroll in unrolls:
+                    out.append(Candidate(
+                        spec, unroll=int(unroll), accumulation_steps=accum,
+                        zero=zero, why=why))
+    if len(out) > budget:
+        logging.warning(
+            "autotune: enumerated %d candidates, keeping the first %d "
+            "(AUTODIST_TUNE_BUDGET) — raise the budget to rank the rest",
+            len(out), budget)
+        out = out[:budget]
+    return out
+
+
+# ------------------------------------------------------------------ stage 1
+
+def _load_calibration(
+        calibration: Optional[costmodel.Calibration]) -> Tuple[
+            costmodel.Calibration, str]:
+    """The prediction calibration, by preference: an explicit object, the
+    newest ``AUTODIST_PROFILE_DIR`` profile (the machine's own achieved
+    rates), else the bundled default."""
+    if calibration is not None:
+        return calibration, "explicit"
+    prof_dir = str(const.ENV.AUTODIST_PROFILE_DIR.val)
+    if prof_dir and os.path.isdir(prof_dir):
+        def mtime(path):
+            # The dir may belong to a concurrently-profiling job (the normal
+            # way to keep calibration fresh): a file rotated away between
+            # listdir and this stat sorts first and is skipped below.
+            try:
+                return os.path.getmtime(path)
+            except OSError:
+                return 0.0
+
+        profiles = sorted(
+            (os.path.join(prof_dir, f) for f in os.listdir(prof_dir)
+             if f.startswith("profile-") and f.endswith(".json")),
+            key=mtime)
+        for path in reversed(profiles):
+            try:
+                with open(path) as f:
+                    calib = costmodel.calibrate(json.load(f))
+                if calib.flops_per_s or calib.host_s_per_dispatch:
+                    return calib, f"profile:{os.path.basename(path)}"
+            except (OSError, ValueError, TypeError):
+                continue
+    return DEFAULT_CALIBRATION, "bundled-default"
+
+
+def _comm_bytes_per_step(model_spec, cand: Candidate) -> float:
+    """The PS-wire bytes one optimizer step moves for an async candidate:
+    a param pull + a gradient push (~2x dense param bytes); the overlapped
+    client hides the pull behind compute, leaving ~the push. Sync
+    candidates cross no host wire — their collectives live inside the
+    compiled program's own cost analysis."""
+    if not cand.asynchronous:
+        return 0.0
+    dense_bytes = sum(s.byte_size for s in model_spec.trainable.values()
+                     if not s.sparse)
+    return float(dense_bytes if cand.overlap else 2 * dense_bytes)
+
+
+def _derive_record(base: Dict[str, Any], unroll: int) -> Dict[str, Any]:
+    """A unroll=K candidate's cost record from its base (unroll=1) probe:
+    the fused block is the same body scanned K times, so flops/bytes scale
+    by K while the dispatch count stays 1 — the amortization
+    ``costmodel.predict`` prices via its per-dispatch host term. (The same
+    rule the runner's cost extraction applies to real fused programs;
+    verified there against a compiled K=4 block.)"""
+    return {"flops": (base.get("flops") or 0.0) * unroll or None,
+            "bytes_accessed": (base.get("bytes_accessed") or 0.0) * unroll
+            or None,
+            "steps": unroll * max(1, int(base.get("steps") or 1)),
+            "dispatches": 1}
+
+
+def _probe_base_costs(cands: List[Candidate], loss_fn, params, optimizer,
+                      example_batch, resource_spec, sparse_names, has_aux):
+    """One compile-only :meth:`plan_costs` probe per distinct base program
+    (builder x accum x zero); async bases borrow the sync PS probe's program
+    costs (their per-worker grad step is the same math minus the collective
+    — the wire term is added separately). Returns ``{base_key: record}``;
+    a failed probe records an error string instead."""
+    from autodist_tpu.autodist import (AutoDist, get_default_autodist,
+                                       set_default_autodist)
+
+    base_costs: Dict[Tuple, Any] = {}
+    sync_ps_cost = None
+    for cand in cands:
+        key = cand.base_key()
+        if key in base_costs:
+            continue
+        if cand.asynchronous:
+            base_costs[key] = None   # filled from the sync PS probe below
+            continue
+        prior = get_default_autodist()
+        ad = runner = None
+        try:
+            with telemetry.span("tune.compile_probe", candidate=cand.name):
+                ad = AutoDist(resource_spec, cand.make_builder())
+                runner = ad.create_distributed_session(
+                    loss_fn, params, optimizer, example_batch=example_batch,
+                    sparse_names=sparse_names, has_aux=has_aux,
+                    accumulation_steps=cand.accumulation_steps,
+                    zero=cand.zero, tune=False)
+                cost = runner.plan_costs(params, example_batch, unroll=1)
+            base_costs[key] = cost if cost is not None else \
+                "probe: backend reported no cost analysis"
+            if cand.builder_spec["name"] == "PSLoadBalancing" \
+                    and isinstance(cost, dict):
+                sync_ps_cost = cost
+        except Exception as e:  # noqa: BLE001 — a candidate failing to build
+            base_costs[key] = f"{type(e).__name__}: {e}"   # must not abort
+            logging.warning("autotune compile probe %s failed: %s",
+                            cand.name, e)
+        finally:
+            # Tear each probe session down before the NEXT probe (and long
+            # before stage 2's timed measurements): a pile of live probe
+            # runners holding compiled executables would skew the very
+            # measurements that pick the winner — measure_candidate's
+            # teardown-before-timing invariant, kept here too.
+            if ad is not None:
+                try:
+                    ad._teardown()
+                except Exception as e:  # noqa: BLE001
+                    logging.warning("autotune probe %s teardown: %s",
+                                    cand.name, e)
+            ad = runner = None  # noqa: F841
+            gc.collect()
+            set_default_autodist(prior)
+    for key, val in base_costs.items():
+        if val is None:   # async base: approximate with the sync PS program
+            base_costs[key] = sync_ps_cost if sync_ps_cost is not None else \
+                "probe: no sync PS base to approximate the async program from"
+    return base_costs
+
+
+# ------------------------------------------------------------------- search
+
+def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
+             resource_spec: Optional[ResourceSpec] = None,
+             sparse_names: Optional[Sequence[str]] = None,
+             has_aux: bool = False,
+             unrolls: Sequence[int] = DEFAULT_UNROLLS,
+             accumulation_steps: Sequence[int] = (1,),
+             top_k: Optional[int] = None,
+             budget: Optional[int] = None,
+             margin: float = PRUNE_MARGIN,
+             calibration: Optional[costmodel.Calibration] = None,
+             plan_cache: Optional[str] = None,
+             warmup_steps: int = 2, measure_steps: int = 8,
+             include_async: Optional[bool] = None) -> TunedPlan:
+    """The two-stage plan search. Returns the winning :class:`TunedPlan`.
+
+    A warm ``plan_cache`` entry (``AUTODIST_PLAN_CACHE`` when None) for this
+    (model, batch, topology, version) returns immediately — zero compile
+    probes, zero measured steps. Otherwise stage 1 compile-probes one base
+    program per (builder, accum, zero), derives the unroll grid analytically,
+    ranks everything with the calibrated cost model, and prunes; stage 2
+    measures at most ``top_k`` (``AUTODIST_TUNE_TOPK``) survivors with
+    ``measure_steps`` real steps each through the tuner's shared loop. The
+    measured winner is persisted to the cache and returned. Raises
+    RuntimeError when every stage-2 probe fails (same contract as
+    ``tune_strategy``)."""
+    from autodist_tpu.model_spec import ModelSpec
+
+    t_start = time.perf_counter()
+    if plan_cache is None:
+        plan_cache = str(const.ENV.AUTODIST_PLAN_CACHE.val)
+    if top_k is None:
+        top_k = int(const.ENV.AUTODIST_TUNE_TOPK.val)
+    if top_k < 1:
+        raise ValueError("top_k must be >= 1 (stage 2 needs at least one "
+                         "measured candidate)")
+    resource_spec = resource_spec if resource_spec is not None \
+        else ResourceSpec(None)
+    if resource_spec.num_nodes > 1:
+        raise ValueError(
+            "autotune probes candidates on THIS process's local devices; a "
+            "multi-node resource spec would be ranked by a measurement that "
+            "ignores the cross-node wire (same contract as tune_strategy)")
+    model_spec = (ModelSpec(params, sparse_names=sparse_names)
+                  if sparse_names is not None
+                  else ModelSpec.from_loss_fn(loss_fn, params, example_batch))
+    key = plan_cache_key(model_spec, example_batch, resource_spec)
+
+    cached = load_cached_plan(plan_cache, key)
+    if cached is not None:
+        telemetry.counter("tune.cache_hit").inc()
+        logging.info("autotune: plan cache hit [%s] -> %s (predicted %s, "
+                     "measured %s steps/s) — zero probe steps", key,
+                     cached.name,
+                     (cached.predicted or {}).get("step_s"),
+                     cached.measured_steps_per_s)
+        return cached
+    telemetry.counter("tune.cache_miss").inc()
+
+    with telemetry.span("tune.search", key=key):
+        # ---- stage 1: enumerate, compile-probe bases, predict, prune
+        cands = enumerate_candidates(
+            model_spec, resource_spec, optimizer, unrolls=unrolls,
+            accums=tuple(accumulation_steps), include_async=include_async,
+            budget=budget)
+        calib, calib_src = _load_calibration(calibration)
+        logging.info("autotune [%s]: %d candidates, calibration %s", key,
+                     len(cands), calib_src)
+        with telemetry.span("tune.predict", candidates=len(cands)):
+            base_costs = _probe_base_costs(
+                cands, loss_fn, params, optimizer, example_batch,
+                resource_spec, sparse_names, has_aux)
+            for c in cands:
+                base = base_costs.get(c.base_key())
+                if not isinstance(base, dict):
+                    c.pruned = str(base)
+                    continue
+                rec = _derive_record(base, c.unroll)
+                c.predicted = costmodel.predict(
+                    rec, calib,
+                    comm_bytes_per_step=_comm_bytes_per_step(model_spec, c))
+        predicted = [c for c in cands if c.predicted is not None]
+        if not predicted:
+            raise RuntimeError(
+                "autotune: no candidate could be compile-probed:\n" +
+                "\n".join(f"  {c.name}: {c.pruned}" for c in cands))
+        best_pred = min(c.predicted["step_s"] for c in predicted)
+        ranked = sorted(predicted, key=lambda c: c.predicted["step_s"])
+        survivors: List[Candidate] = []
+        for c in ranked:
+            if c.asynchronous:
+                c.pruned = ("skipped: async candidate — predicted only, "
+                            "not measurable by the synchronous probe loop")
+            elif c.predicted["step_s"] > (1.0 + margin) * best_pred:
+                c.pruned = (f"predicted {c.predicted['step_s'] * 1e3:.3f} "
+                            f"ms/step, > {1.0 + margin:.2f}x the frontrunner"
+                            f" ({best_pred * 1e3:.3f} ms)")
+            elif len(survivors) >= top_k:
+                c.pruned = f"beyond top-k={top_k}"
+            else:
+                survivors.append(c)
+        telemetry.gauge("tune.candidates").set(len(cands))
+        telemetry.gauge("tune.pruned").set(len(cands) - len(survivors))
+
+        # ---- stage 2: measure the survivors with real steps
+        for c in survivors:
+            with telemetry.span("tune.probe", candidate=c.name):
+                c.probe = measure_candidate(
+                    c.make_builder(), loss_fn, params, optimizer,
+                    example_batch, name=c.name, resource_spec=resource_spec,
+                    warmup_steps=warmup_steps, measure_steps=measure_steps,
+                    sparse_names=sparse_names, has_aux=has_aux,
+                    accumulation_steps=c.accumulation_steps,
+                    unroll=c.unroll, zero=c.zero)
+        telemetry.gauge("tune.probed").set(len(survivors))
+        measured = [c for c in survivors
+                    if c.probe is not None
+                    and c.probe.steps_per_sec is not None]
+        if not measured:
+            raise RuntimeError(
+                "autotune: every stage-2 probe failed or was skipped:\n" +
+                "\n".join(f"  {c.name}: {c.probe.error}" for c in survivors))
+        winner = max(measured, key=lambda c: c.probe.steps_per_sec)
+
+    plan = TunedPlan(
+        builder_spec=winner.builder_spec, unroll=winner.unroll,
+        accumulation_steps=winner.accumulation_steps, zero=winner.zero,
+        overlap=winner.overlap, predicted=winner.predicted,
+        measured_steps_per_s=winner.probe.steps_per_sec, cache_key=key,
+        search_s=time.perf_counter() - t_start, enumerated=len(cands),
+        probed=len(survivors), candidates=cands)
+    telemetry.gauge("tune.best_steps_per_s").set(plan.measured_steps_per_s)
+    telemetry.gauge("tune.search_s").set(plan.search_s)
+    if plan_cache:
+        store_plan(plan_cache, plan)
+    logging.info("autotune winner [%s]: %s (%.2f steps/s measured, %.2f ms "
+                 "predicted, %d/%d probed, %.2fs search)", key, plan.name,
+                 plan.measured_steps_per_s,
+                 (plan.predicted or {}).get("step_s", 0.0) * 1e3,
+                 plan.probed, plan.enumerated, plan.search_s)
+    return plan

@@ -9,6 +9,12 @@ the user (its performance guide tunes ``chunk_size`` per model by hand,
 
 Candidates that fail to build or run (OOM, unsupported model shape) are
 recorded and skipped rather than aborting the search.
+
+:func:`measure_candidate` is the ONE build/run/timing loop: ``tune_strategy``
+drives it over its candidate sweep, and the plan autotuner
+(:mod:`autodist_tpu.strategy.autotune`) reuses it as its stage-2 probe — the
+failure-skip semantics (a candidate OOMing or landing in the async regime is
+recorded, never fatal) live here so the two paths cannot drift.
 """
 
 import dataclasses
@@ -28,6 +34,8 @@ class CandidateResult:
     steps_per_sec: Optional[float]    # None = failed or skipped
     error: Optional[str] = None
     accumulation_steps: int = 1
+    unroll: int = 1
+    zero: int = 0
 
 
 @dataclasses.dataclass
@@ -66,6 +74,129 @@ def _default_candidates(has_sparse: bool) -> List[StrategyBuilder]:
     return cands
 
 
+def measure_candidate(builder: StrategyBuilder, loss_fn: Callable, params: Any,
+                      optimizer, example_batch: Any, *,
+                      name: Optional[str] = None,
+                      resource_spec: Optional[ResourceSpec] = None,
+                      warmup_steps: int = 2, measure_steps: int = 8,
+                      sparse_names: Optional[Sequence[str]] = None,
+                      has_aux: bool = False, accumulation_steps: int = 1,
+                      unroll: int = 1,
+                      zero: Optional[int] = None) -> CandidateResult:
+    """Build ONE candidate's session and time a few real steps on this
+    process's devices — the shared probe loop behind :func:`tune_strategy`
+    and the autotuner's stage 2.
+
+    The candidate gets ``warmup_steps`` dispatches (compile + first dispatch,
+    pipeline-fenced by a host read of the loss) then ``measure_steps`` timed
+    dispatches; with ``unroll=K`` each dispatch is one fused K-step block
+    (:meth:`DistributedRunner.run_many` over a pre-stacked block of the same
+    batch), so ``steps_per_sec`` always counts OPTIMIZER steps and stays
+    comparable across unroll factors. The batch is pre-placed once, so the
+    timed loop measures the strategy + knobs, not the host link.
+
+    ``zero=None`` (the default) leaves the session reading the
+    ``AUTODIST_ZERO`` flag — the pre-refactor tuner behavior; the autotuner
+    passes each candidate's explicit value.
+
+    Failure-skip semantics (test-pinned): a candidate that fails to build or
+    run returns ``steps_per_sec=None`` with the error recorded; a candidate
+    landing in the async regime (``sync=False`` / ``staleness>0``) is
+    recorded as skipped — its gate-dominated wall-clock is not comparable to
+    a synchronous step. Everything the candidate launched is torn down and
+    the process-default AutoDist instance is restored before returning."""
+    from autodist_tpu.autodist import (AutoDist, get_default_autodist,
+                                       set_default_autodist)
+
+    # Argument errors raise HERE, before the failure-skip guard: a bad
+    # warmup_steps must surface as the caller's mistake, not be swallowed
+    # into a fake every-candidate-failed search result.
+    if warmup_steps < 1:
+        raise ValueError("warmup_steps must be >= 1 (the timed loop needs a "
+                         "compiled, pipeline-fenced step to start from)")
+    if measure_steps < 1:
+        raise ValueError("measure_steps must be >= 1")
+    if unroll < 1:
+        raise ValueError("unroll must be >= 1")
+    if name is None:
+        name = type(builder).__name__
+    zero_rec = int(zero or 0)   # result-record value; None stays env-driven
+    prior_default = get_default_autodist()  # the candidate must not leak as default
+    ad = None
+    runner = state = batch = block = loss = None
+    try:
+        ad = AutoDist(resource_spec, builder)
+        runner = ad.create_distributed_session(
+            loss_fn, params, optimizer, example_batch=example_batch,
+            sparse_names=sparse_names, has_aux=has_aux,
+            accumulation_steps=accumulation_steps, zero=zero, tune=False)
+        from autodist_tpu.parallel.staleness import AsyncPSRunner
+        if isinstance(runner, AsyncPSRunner):
+            # Gate-dominated wall-clock is not comparable to a sync step;
+            # record the skip instead of a misleading rate.
+            logging.warning("measure_candidate %s: skipped (async regime)",
+                            name)
+            return CandidateResult(
+                builder, name, None,
+                "skipped: async candidate (sync=False / staleness>0) — "
+                "candidate measurement ranks synchronous strategies only",
+                accumulation_steps=accumulation_steps, unroll=unroll,
+                zero=zero_rec)
+        state = runner.init(params)
+
+        def run_once(s):
+            if unroll > 1:
+                return runner.run_many(s, block)
+            return runner.run(s, batch)
+
+        # Pre-place the batch (run()'s resident-array check then makes the
+        # per-step shard a no-op) / pre-stack the block, so the timed loop
+        # measures the strategy, not the host link.
+        if unroll > 1:
+            block = runner.shard_block([example_batch] * unroll)
+        else:
+            batch = runner.shard_batch(example_batch)
+        for _ in range(warmup_steps):
+            state, fetched = run_once(state)
+        loss = fetched[0] if has_aux else fetched
+        _fence(loss)  # compile + pipeline fence before the clock starts
+        t0 = time.perf_counter()
+        for _ in range(measure_steps):
+            state, fetched = run_once(state)
+        loss = fetched[0] if has_aux else fetched
+        _fence(loss)  # completion fence (device->host read)
+        rate = measure_steps * unroll / (time.perf_counter() - t0)
+        logging.info("measure_candidate %s: %.2f steps/s", name, rate)
+        return CandidateResult(builder, name, rate,
+                               accumulation_steps=accumulation_steps,
+                               unroll=unroll, zero=zero_rec)
+    except Exception as e:  # noqa: BLE001 — a candidate OOMing must not abort
+        logging.warning("measure_candidate %s failed: %s", name, e)
+        return CandidateResult(builder, name, None,
+                               f"{type(e).__name__}: {e}",
+                               accumulation_steps=accumulation_steps,
+                               unroll=unroll, zero=zero_rec)
+    finally:
+        # Tear down anything the candidate launched (clusters, PS
+        # transports) and drop state + executables before the next
+        # candidate is timed.
+        if ad is not None:
+            try:
+                ad._teardown()
+            except Exception as e:  # noqa: BLE001
+                logging.warning("measure_candidate %s teardown: %s", name, e)
+        state = batch = block = runner = ad = loss = None  # noqa: F841
+        gc.collect()
+        set_default_autodist(prior_default)
+
+
+def _fence(loss):
+    """Host-read the (possibly ``[K]``-stacked) loss: the dispatch fence both
+    ends of the timed loop need."""
+    import numpy as np
+    np.asarray(loss).reshape(-1)[-1].item()
+
+
 def tune_strategy(loss_fn: Callable, params: Any, optimizer,
                   example_batch: Any,
                   candidates: Optional[Sequence[StrategyBuilder]] = None,
@@ -95,8 +226,6 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
     global batch is fixed); ``result.best_accumulation_steps`` carries the
     winner's setting.
     """
-    from autodist_tpu.autodist import (AutoDist, get_default_autodist,
-                                       set_default_autodist)
     from autodist_tpu.model_spec import ModelSpec
 
     if warmup_steps < 1:
@@ -135,68 +264,16 @@ def tune_strategy(loss_fn: Callable, params: Any, optimizer,
         has_sparse = any(p.sparse for p in spec.trainable.values())
         candidates = _default_candidates(has_sparse)
 
-    prior_default = get_default_autodist()  # candidates must not leak as default
     results: List[CandidateResult] = []
-    try:
-        for builder, accum in ((b, a) for b in candidates for a in accum_sweep):
-            name = type(builder).__name__
-            if len(accum_sweep) > 1:
-                name = f"{name}[accum={accum}]"
-            ad = None
-            try:
-                ad = AutoDist(resource_spec, builder)
-                runner = ad.create_distributed_session(
-                    loss_fn, params, optimizer, example_batch=example_batch,
-                    sparse_names=sparse_names, has_aux=has_aux,
-                    accumulation_steps=accum)
-                from autodist_tpu.parallel.staleness import AsyncPSRunner
-                if isinstance(runner, AsyncPSRunner):
-                    # Gate-dominated wall-clock is not comparable to a sync
-                    # step; record the skip instead of a misleading rate.
-                    results.append(CandidateResult(
-                        builder, name, None,
-                        "skipped: async candidate (sync=False / staleness>0) — "
-                        "tune_strategy ranks synchronous strategies only",
-                        accumulation_steps=accum))
-                    logging.warning("tune_strategy %s: skipped (async regime)",
-                                    name)
-                    continue
-                state = runner.init(params)
-                # Pre-place the batch: run()'s resident-array check then makes the
-                # per-step shard a no-op, so the timed loop measures the strategy,
-                # not the host link.
-                batch = runner.shard_batch(example_batch)
-                for _ in range(warmup_steps):
-                    state, fetched = runner.run(state, batch)
-                loss = fetched[0] if has_aux else fetched
-                float(loss)  # compile + pipeline fence before the clock starts
-                t0 = time.perf_counter()
-                for _ in range(measure_steps):
-                    state, fetched = runner.run(state, batch)
-                loss = fetched[0] if has_aux else fetched
-                float(loss)  # completion fence (device->host read)
-                rate = measure_steps / (time.perf_counter() - t0)
-                results.append(CandidateResult(builder, name, rate,
-                                               accumulation_steps=accum))
-                logging.info("tune_strategy %s: %.2f steps/s", name, rate)
-            except Exception as e:  # noqa: BLE001 — a candidate OOMing must not abort
-                results.append(
-                    CandidateResult(builder, name, None, f"{type(e).__name__}: {e}",
-                                    accumulation_steps=accum))
-                logging.warning("tune_strategy %s failed: %s", name, e)
-            finally:
-                # Tear down anything the candidate launched (clusters, PS
-                # transports) and drop state + executables before the next
-                # candidate is timed.
-                if ad is not None:
-                    try:
-                        ad._teardown()
-                    except Exception as e:  # noqa: BLE001
-                        logging.warning("tune_strategy %s teardown: %s", name, e)
-                state = batch = runner = ad = loss = None  # noqa: F841
-                gc.collect()
-    finally:
-        set_default_autodist(prior_default)
+    for builder, accum in ((b, a) for b in candidates for a in accum_sweep):
+        name = type(builder).__name__
+        if len(accum_sweep) > 1:
+            name = f"{name}[accum={accum}]"
+        results.append(measure_candidate(
+            builder, loss_fn, params, optimizer, example_batch, name=name,
+            resource_spec=resource_spec, warmup_steps=warmup_steps,
+            measure_steps=measure_steps, sparse_names=sparse_names,
+            has_aux=has_aux, accumulation_steps=accum))
 
     ranked = [r for r in results if r.steps_per_sec is not None]
     if not ranked:

@@ -48,7 +48,8 @@ from autodist_tpu.utils import logging
 
 __all__ = ["PeakSpec", "peak_spec", "ProgramCost", "enable", "disable",
            "active", "reset", "note_dispatch", "record_program_cost",
-           "program_costs", "set_analytic_flops", "observe_period",
+           "program_costs", "set_analytic_flops", "set_applied_plan",
+           "applied_plan", "observe_period",
            "format_attr_line", "format_shares", "attribution_periods",
            "profile_document",
            "write_profile", "maybe_write_profile", "PROFILE_SCHEMA",
@@ -205,6 +206,12 @@ class _State:
         self.periods: List[Dict[str, Any]] = []
         self.period_start_ns: Optional[int] = None
         self.last_dispatches: Dict[str, int] = {}
+        # The execution plan this process applied (the autotuner's record:
+        # cache key + knobs + predicted vs measured) — attached to profile
+        # JSONs and flight-recorder manifests so a snapshot or adprof diff
+        # names which plan a run was executing. Survives reset(): it
+        # describes the session, not an attribution period.
+        self.applied_plan: Optional[Dict[str, Any]] = None
 
 
 _STATE = _State()
@@ -253,6 +260,20 @@ def reset():
         _STATE.analytic_flops_per_step = None
         _STATE.period_start_ns = (time.perf_counter_ns()
                                   if _STATE.enabled else None)
+
+
+def set_applied_plan(plan: Optional[Dict[str, Any]]):
+    """Record the execution plan this process is running (the autotuner's
+    ``TunedPlan.to_dict()`` + name). Rides every subsequently-written
+    profile document (``"plan"`` key) and flight-recorder manifest, so
+    diagnostics name the plan a run was executing. ``None`` clears."""
+    with _STATE.lock:
+        _STATE.applied_plan = dict(plan) if plan else None
+
+
+def applied_plan() -> Optional[Dict[str, Any]]:
+    with _STATE.lock:
+        return dict(_STATE.applied_plan) if _STATE.applied_plan else None
 
 
 def set_analytic_flops(flops_per_step: Optional[float]):
@@ -550,6 +571,12 @@ def profile_document(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "periods": periods,
         "summary": _summary(periods, costs),
     }
+    plan = applied_plan()
+    if plan:
+        # Which execution plan produced these numbers (autotuner record:
+        # cache key + knobs + predicted vs measured) — so adprof diffs can
+        # say "the regression is plan A vs plan B", not just "it got slower".
+        doc["plan"] = plan
     # PS-wire traffic, when the run mirrored any (the registry's ps.wire.*
     # counters): costmodel.calibrate derives the measured wire bandwidth
     # from these + the comm share — the interconnect term of predict().

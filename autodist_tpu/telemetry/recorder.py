@@ -75,6 +75,16 @@ def build_manifest(reason: str, seq: Optional[int] = None) -> Dict[str, Any]:
         manifest["versions"]["jax"] = jax.__version__
     except Exception:   # jax-less diagnostics still snapshot
         pass
+    try:
+        # The applied execution plan (autotuner record: cache key + knobs +
+        # predicted vs measured), when one was applied — a snapshot names
+        # which plan the run it captured was executing.
+        from autodist_tpu.telemetry import profiling as _profiling
+        plan = _profiling.applied_plan()
+        if plan:
+            manifest["plan"] = plan
+    except Exception:   # diagnostics must never fail the snapshot
+        pass
     return manifest
 
 

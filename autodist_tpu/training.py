@@ -69,7 +69,7 @@ def train(runner, params: PyTree,
           eval_batch: Any = None,
           eval_fn: Optional[Callable] = None,
           on_eval: Optional[Callable[[int, Any], None]] = None,
-          unroll: int = 1,
+          unroll: Optional[int] = None,
           health_monitor: Optional["_health.HealthMonitor"] = None) -> TrainState:
     """Run ``steps`` global steps, checkpointing and resuming automatically.
 
@@ -88,6 +88,12 @@ def train(runner, params: PyTree,
     forward-only :meth:`evaluate` runs every ``eval_every`` steps on the
     current params (``eval_fn`` defaults to the loss) and ``on_eval(step,
     value)`` receives the result. Returns the final :class:`TrainState`.
+
+    ``unroll=None`` (the default) adopts the runner's tuned plan when one is
+    attached (``create_distributed_session(tune=True)`` sets
+    ``runner.tuned_plan``; its ``unroll`` is the autotuner's measured
+    winner) and otherwise behaves as ``unroll=1``; pass an explicit value
+    to override the tuned knob.
 
     ``unroll=K`` (K > 1) switches the loop to the fused dispatch-ahead
     pipeline: K consecutive batches are stacked into one pre-sharded block and
@@ -112,6 +118,13 @@ def train(runner, params: PyTree,
     :class:`telemetry.HealthHalt` carrying the live state. Monitoring needs
     ``log_every > 0`` (boundaries are where readbacks happen).
     """
+    if unroll is None:
+        tuned = getattr(runner, "tuned_plan", None)
+        unroll = int(getattr(tuned, "unroll", 1) or 1)
+        if unroll > 1:
+            logging.info("train: adopting tuned plan unroll=%d (%s; pass "
+                         "unroll= explicitly to override)", unroll,
+                         getattr(tuned, "name", "tuned plan"))
     if unroll < 1:
         raise ValueError("unroll must be >= 1")
     if eval_every and eval_batch is None:

@@ -1048,6 +1048,112 @@ def unroll_sweep(factors):
     return result
 
 
+def autotune_bench(rounds: int = 3, steps: int = 48):
+    """Plan-autotuner gate: tuned plan vs default plan steps/s on the CPU
+    micro-model (the host-dispatch-bound shape class where the knob space —
+    unroll amortization above all — has real headroom).
+
+    Runs one full predict-prune-probe search (``strategy.autotune``) with a
+    throwaway plan cache, then measures the DEFAULT plan (the session's
+    PSLoadBalancing builder, ``unroll=1``) and the TUNED winner back-to-back
+    (best of ``rounds`` interleaved rounds, ~``steps`` optimizer steps each,
+    through the tuner's shared probe loop so both sides pay identical
+    harness cost). Gated numbers in the PERF_BASELINE.json ``autotune``
+    row:
+
+    - ``tuned_vs_default`` >= ``min_ratio`` (1.0): the searched plan must
+      never lose to the default it replaces;
+    - ``probed`` <= ``top_k``: stage-1 pruning must hold — at most top-k of
+      the enumerated candidates get measured probe steps (the search-cost
+      contract; ``search_s`` reports the wall cost)."""
+    import sys
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import const
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import PSLoadBalancing
+    from autodist_tpu.strategy.autotune import autotune
+    from autodist_tpu.strategy.tuner import measure_candidate
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+
+    top_k = int(const.ENV.AUTODIST_TUNE_TOPK.val)
+    with tempfile.TemporaryDirectory() as tmp:
+        plan = autotune(loss_fn, params, optax.adam(1e-3), batch,
+                        plan_cache=f"{tmp}/plan_cache.json",
+                        warmup_steps=2, measure_steps=6)
+
+    def measure(builder, unroll, zero, accum):
+        n = max(4, steps // unroll)
+        r = measure_candidate(builder, loss_fn, params, optax.adam(1e-3),
+                              batch, warmup_steps=2, measure_steps=n,
+                              unroll=unroll, zero=zero,
+                              accumulation_steps=accum)
+        return r.steps_per_sec or 0.0
+
+    best = {"default": 0.0, "tuned": 0.0}
+    for _ in range(rounds):   # interleaved: load noise hits both sides
+        best["default"] = max(best["default"],
+                              measure(PSLoadBalancing(), 1, 0, 1))
+        best["tuned"] = max(best["tuned"],
+                            measure(plan.make_builder(), plan.unroll,
+                                    plan.zero, plan.accumulation_steps))
+
+    ratio = best["tuned"] / best["default"] if best["default"] else 0.0
+    result = {
+        "metric": f"autotune ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size})",
+        "unit": "steps/s",
+        "rows": {"default": round(best["default"], 2),
+                 "tuned": round(best["tuned"], 2)},
+        "tuned_vs_default": round(ratio, 4),
+        "plan": plan.name,
+        "predicted_step_ms": round((plan.predicted or {}).get("step_s", 0.0)
+                                   * 1e3, 4),
+        "search_s": round(plan.search_s, 2),
+        "enumerated": plan.enumerated,
+        "probed": plan.probed,
+        "top_k": top_k,
+    }
+    if plan.probed > top_k:
+        print(f"WARNING: autotune measured-probed {plan.probed} candidates, "
+              f"above top_k={top_k} — stage-1 pruning stopped bounding the "
+              f"search cost (see strategy/autotune.py)", file=sys.stderr)
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("autotune")
+        if recorded and recorded.get("platform") == platform:
+            floor = recorded.get("min_ratio", 1.0)
+            if ratio < floor:
+                print(f"WARNING: tuned plan is {ratio:.2f}x the default "
+                      f"plan's steps/s, below the {floor:.2f}x floor — the "
+                      f"autotuner picked a losing plan (see "
+                      f"PERF_BASELINE.json autotune)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["tuned"],
+                        "unit": "steps/s", "plan": plan.name,
+                        "tuned_vs_default": result["tuned_vs_default"],
+                        "search_s": result["search_s"],
+                        "probed": plan.probed})
+    return result
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -1111,6 +1217,13 @@ def main(argv=None):
              "serving row in PERF_BASELINE.json (continuous must beat static "
              "on requests/s at equal-or-better p99)")
     parser.add_argument(
+        "--autotune", action="store_true",
+        help="run the plan autotuner's full predict-prune-probe search on "
+             "the CPU micro-model and gate the winner: tuned plan steps/s "
+             "must be >= min_ratio x the default plan's (PERF_BASELINE.json "
+             "autotune row) and stage-1 pruning must measure at most top-k "
+             "of the enumerated candidates; reports the search cost")
+    parser.add_argument(
         "--profile", type=int, default=0, metavar="N",
         help="dump a jax.profiler trace (Perfetto/TensorBoard format) of an "
              "N-step window after warmup; the trace directory is reported in "
@@ -1136,6 +1249,9 @@ def main(argv=None):
         return
     if args.serve:
         serve_bench()
+        return
+    if args.autotune:
+        autotune_bench()
         return
     if args.unroll:
         try:

@@ -116,6 +116,10 @@ rm -rf "$ADPROF_SMOKE_DIR"
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead
+# Plan-autotuner gate: the predict-prune-probe search must measure at most
+# top-k of the enumerated candidates and its winner must not lose to the
+# default plan (autotune row: tuned/default >= min_ratio).
+python bench.py --autotune
 # Serving plane gate: continuous batching must beat static wave batching
 # on loopback requests/s at equal-or-better p99 (serving row).
 python bench.py --serve

@@ -1,0 +1,442 @@
+"""Plan autotuner: enumerate, predict-prune, probe, cache, explain.
+
+Covers the predict-prune-probe contract end to end
+(docs/usage/performance.md "Plan autotuning"):
+
+- candidate enumeration from AutoStrategy's analytic rules (regime/sparse/
+  memory gates) jointly with the unroll/zero/accum/overlap knobs;
+- the compile-only cost probe (``DistributedRunner.plan_costs``): real XLA
+  cost analysis, scaling across unroll factors, and NO step dispatches;
+- stage-1 pruning: at most top-k candidates are measured, and the measured
+  winner's knobs match the actually-fastest config within a band on the
+  CPU micro-model;
+- the persistent plan cache: schema-versioned file, warm hit applies the
+  tuned plan with ZERO probe steps (test-pinned via a poisoned probe loop),
+  invalidation by model-signature/topology key change, corrupt-file and
+  wrong-schema tolerance;
+- ``explain()``/``to_dict()`` schema, the applied-plan record riding
+  profile documents and flight-recorder manifests, and flag typing.
+
+Pure in-process host tests — no subprocess spawns (GL008-clean), named to
+sort inside the tier-1 window (before test_image_data).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist, const, telemetry  # noqa: E402
+from autodist_tpu.model_spec import ModelSpec  # noqa: E402
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
+from autodist_tpu.strategy import (AllReduce, Candidate,  # noqa: E402
+                                   PSLoadBalancing, TunedPlan, autotune,
+                                   enumerate_candidates, plan_cache_key)
+# The package re-exports the `autotune` FUNCTION under the submodule's
+# name, so attribute-style imports resolve the function; fetch the module.
+import importlib  # noqa: E402
+autotune_mod = importlib.import_module("autodist_tpu.strategy.autotune")
+from autodist_tpu.telemetry import costmodel, profiling  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Leave process-global telemetry/profiling/applied-plan as found."""
+    telemetry.disable()
+    telemetry.clear()
+    profiling.disable()
+    profiling.reset()
+    profiling.set_applied_plan(None)
+    yield
+    telemetry.disable()
+    telemetry.clear()
+    profiling.disable()
+    profiling.reset()
+    profiling.set_applied_plan(None)
+
+
+# ------------------------------------------------------------------ fixtures
+
+def _loss(p, b):
+    return jnp.mean((b["y"] - b["x"] @ p["w"]) ** 2)
+
+
+def _params():
+    return {"w": np.random.RandomState(0).randn(8, 4).astype(np.float32)}
+
+
+def _batch(rows=16):
+    rng = np.random.RandomState(1)
+    return {"x": rng.randn(rows, 8).astype(np.float32),
+            "y": rng.randn(rows, 4).astype(np.float32)}
+
+
+def _model_spec():
+    return ModelSpec.from_loss_fn(_loss, _params(), _batch())
+
+
+def _fast_autotune(**kw):
+    kw.setdefault("warmup_steps", 1)
+    kw.setdefault("measure_steps", 2)
+    kw.setdefault("unrolls", (1, 8))
+    kw.setdefault("top_k", 2)
+    kw.setdefault("plan_cache", "")
+    return autotune(_loss, _params(), optax.sgd(0.1), _batch(), **kw)
+
+
+@pytest.fixture(scope="module")
+def searched(tmp_path_factory):
+    """ONE real end-to-end search shared by every test that only reads its
+    result (ranking, cache file, explain table) — searches compile several
+    candidate programs, so each extra one costs seconds of tier-1 window."""
+    cache = str(tmp_path_factory.mktemp("plans") / "plan_cache.json")
+    return _fast_autotune(plan_cache=cache), cache
+
+
+# -------------------------------------------------------------------- flags
+
+def test_new_flags_registered_and_typed(monkeypatch):
+    for flag in ("AUTODIST_TUNE", "AUTODIST_PLAN_CACHE",
+                 "AUTODIST_TUNE_TOPK", "AUTODIST_TUNE_BUDGET"):
+        assert flag in const.KNOWN_FLAGS and const.KNOWN_FLAGS[flag]
+        assert hasattr(const.ENV, flag)
+    monkeypatch.setenv("AUTODIST_TUNE", "1")
+    assert const.ENV.AUTODIST_TUNE.val is True
+    monkeypatch.setenv("AUTODIST_PLAN_CACHE", "/tmp/pc.json")
+    assert const.ENV.AUTODIST_PLAN_CACHE.val == "/tmp/pc.json"
+    monkeypatch.setenv("AUTODIST_TUNE_TOPK", "5")
+    assert const.ENV.AUTODIST_TUNE_TOPK.val == 5
+    monkeypatch.setenv("AUTODIST_TUNE_BUDGET", "7")
+    assert const.ENV.AUTODIST_TUNE_BUDGET.val == 7
+
+
+# -------------------------------------------------------------- enumeration
+
+def test_enumerate_joint_space_and_determinism():
+    spec, rs = _model_spec(), ResourceSpec(None)
+    cands = enumerate_candidates(spec, rs, optax.sgd(0.1),
+                                 unrolls=(1, 2), accums=(1,))
+    names = [c.name for c in cands]
+    # Deterministic order, AllReduce and the PS default both compete, and
+    # the unroll x zero grid crosses every builder (8 local devices => the
+    # zero knob is live).
+    assert names == [c.name for c in enumerate_candidates(
+        spec, rs, optax.sgd(0.1), unrolls=(1, 2), accums=(1,))]
+    assert "AllReduce" in names and "PSLoadBalancing" in names
+    assert "AllReduce[unroll=2]" in names
+    assert "AllReduce[zero=1]" in names and "AllReduce[unroll=2,zero=1]" in names
+    # Small dense model on a roomy budget: no async regime, no partitioning.
+    assert not any(c.asynchronous for c in cands)
+    assert not any("Partitioned" in n for n in names)
+    assert all(c.why for c in cands)
+
+
+def test_enumerate_async_overlap_knob_when_requested():
+    cands = enumerate_candidates(_model_spec(), ResourceSpec(None),
+                                 optax.sgd(0.1), unrolls=(1,),
+                                 include_async=True)
+    async_c = [c for c in cands if c.asynchronous]
+    # The async regime enumerates the overlap knob on/off, at unroll=1 only
+    # (no fused block in the host-driven loop).
+    assert {c.overlap for c in async_c} == {True, False}
+    assert all(c.unroll == 1 for c in async_c)
+
+
+def test_enumerate_budget_cap():
+    cands = enumerate_candidates(_model_spec(), ResourceSpec(None),
+                                 optax.sgd(0.1), unrolls=(1, 2, 4, 8),
+                                 budget=5)
+    assert len(cands) == 5
+
+
+# ----------------------------------------------------- compile-only probe
+
+def test_plan_costs_compile_only_no_step_dispatch(monkeypatch):
+    """The stage-1 probe must never execute a step: runner.run/run_many are
+    poisoned, and the probe still returns real XLA costs that scale ~Kx
+    across unroll factors."""
+    from autodist_tpu.runner import DistributedRunner
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.1),
+                                           example_batch=_batch())
+
+    def boom(*a, **k):
+        raise AssertionError("plan_costs dispatched a training step")
+
+    monkeypatch.setattr(DistributedRunner, "run", boom)
+    monkeypatch.setattr(DistributedRunner, "run_many", boom)
+    c1 = runner.plan_costs(_params(), _batch(), unroll=1)
+    c4 = runner.plan_costs(_params(), _batch(), unroll=4)
+    assert c1["flops"] > 0 and c1["steps"] == 1 and c1["dispatches"] == 1
+    assert c4["steps"] == 4
+    # The fused block is the scanned body xK (+ constant overhead): the
+    # probe's flops must scale close to linearly.
+    assert 2.0 < c4["flops"] / c1["flops"] < 6.0
+    # No dispatch was counted against the profiling plane either.
+    assert profiling.program_costs() == {}
+
+
+def test_plan_costs_feeds_costmodel_predict():
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(_loss, _params(), optax.sgd(0.1),
+                                           example_batch=_batch())
+    rec = runner.plan_costs(_params(), _batch(), unroll=4)
+    calib = costmodel.Calibration(flops_per_s=1e9, bytes_per_s=1e9,
+                                  host_s_per_dispatch=1e-3)
+    out = costmodel.predict(rec, calib)
+    # 4 steps ride one dispatch: the host term amortizes to 1ms/4.
+    assert out["step_s"] > 0
+    assert abs(out["breakdown"]["host_s"] - 1e-3 / 4) < 1e-9
+
+
+# ------------------------------------------------------------ search + prune
+
+def test_autotune_prunes_to_topk_and_winner_within_band(searched):
+    """End-to-end search on the micro-model: at most top-k candidates get
+    measured probes, the winner IS a measured candidate, and its measured
+    rate is the best of the probes (the prune must not have dropped the
+    measured-best survivor)."""
+    plan, _ = searched
+    probed = [c for c in plan.candidates if c.probe is not None]
+    assert 0 < len(probed) <= 2 and plan.probed == len(probed)
+    measured = [c for c in probed if c.probe.steps_per_sec is not None]
+    assert measured, "at least one probe must succeed on the micro-model"
+    best = max(measured, key=lambda c: c.probe.steps_per_sec)
+    assert plan.measured_steps_per_s == best.probe.steps_per_sec
+    assert plan.knobs_dict()["builder"] == best.builder_spec
+    assert plan.unroll == best.unroll
+    # Everything NOT probed carries a prune/skip reason.
+    assert all(c.pruned for c in plan.candidates if c.probe is None)
+    assert plan.enumerated == len(plan.candidates)
+    assert plan.search_s > 0
+    # With the bundled calibration (host cost per dispatch dominates the
+    # micro-model), stage 1 must rank deeper unrolls ahead: the measured
+    # survivors are all unroll=8 candidates.
+    assert all(c.unroll == 8 for c in probed)
+    assert plan.unroll == 8
+
+
+def test_autotune_rejects_multinode_and_bad_topk():
+    two_nodes = ResourceSpec(
+        "nodes: [{address: 10.0.0.1, tpus: 4, chief: true}, "
+        "{address: 10.0.0.2, tpus: 4}]")
+    with pytest.raises(ValueError, match="multi-node"):
+        _fast_autotune(resource_spec=two_nodes)
+    with pytest.raises(ValueError, match="top_k"):
+        _fast_autotune(top_k=0)
+
+
+# ------------------------------------------------------------------- cache
+
+def test_cache_hit_skips_probing(searched, monkeypatch):
+    """Warm plan-cache launch applies the tuned plan with ZERO probe steps:
+    after the first search persists, the probe loop and the compile probe
+    are both poisoned and the second call still returns the plan."""
+    plan, cache = searched
+    assert not plan.from_cache and os.path.exists(cache)
+
+    def boom(*a, **k):
+        raise AssertionError("a warm cache hit ran a probe")
+
+    monkeypatch.setattr(autotune_mod, "measure_candidate", boom)
+    monkeypatch.setattr(autotune_mod, "_probe_base_costs", boom)
+    warm = _fast_autotune(plan_cache=cache)
+    assert warm.from_cache
+    assert warm.knobs_dict() == plan.knobs_dict()
+    assert warm.measured_steps_per_s == plan.measured_steps_per_s
+    assert isinstance(warm.make_builder(), (AllReduce, PSLoadBalancing))
+
+
+def test_cache_schema_and_invalidation_by_key(searched):
+    plan, cache = searched
+    doc = json.load(open(cache))
+    assert doc["schema"] == autotune_mod.PLAN_SCHEMA
+    assert doc["schema_version"] == autotune_mod.PLAN_SCHEMA_VERSION
+    assert plan.cache_key in doc["plans"]
+    entry = doc["plans"][plan.cache_key]
+    for key in ("cache_key", "knobs", "predicted", "measured_steps_per_s",
+                "search_s", "created"):
+        assert key in entry, key
+    # A different model signature keys differently -> the lookup misses.
+    other = ModelSpec({"w": np.zeros((16, 4), np.float32)})
+    other_key = plan_cache_key(other, _batch(), ResourceSpec(None))
+    assert other_key != plan.cache_key
+    assert autotune_mod.load_cached_plan(cache, other_key) is None
+    # Same model, different batch shape: also a distinct problem.
+    spec = _model_spec()
+    k_b16 = plan_cache_key(spec, _batch(16), ResourceSpec(None))
+    k_b32 = plan_cache_key(spec, _batch(32), ResourceSpec(None))
+    assert k_b16 != k_b32
+
+
+def test_cache_key_depends_on_topology_and_version(monkeypatch):
+    spec = _model_spec()
+    base = plan_cache_key(spec, _batch(), ResourceSpec(None))
+    assert base == plan_cache_key(spec, _batch(), ResourceSpec(None))
+    import autodist_tpu.version as version_mod
+    monkeypatch.setattr(version_mod, "__version__", "999.0.0")
+    assert plan_cache_key(spec, _batch(), ResourceSpec(None)) != base
+    monkeypatch.undo()
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    assert plan_cache_key(spec, _batch(), ResourceSpec(None)) != base
+
+
+def test_cache_tolerates_corrupt_and_wrong_schema_files(tmp_path):
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    assert autotune_mod.load_cached_plan(str(corrupt), "k") is None
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"schema": "other", "schema_version": 99,
+                                 "plans": {"k": {}}}))
+    assert autotune_mod.load_cached_plan(str(wrong), "k") is None
+    # store_plan over a corrupt file recreates it.
+    plan = TunedPlan(builder_spec={"name": "AllReduce"}, cache_key="k2")
+    assert autotune_mod.store_plan(str(corrupt), plan)
+    assert autotune_mod.load_cached_plan(str(corrupt), "k2") is not None
+    # A second job's entry MERGES (read-modify-write under the lock): the
+    # first plan survives the second store.
+    other = TunedPlan(builder_spec={"name": "PSLoadBalancing"},
+                      cache_key="k3")
+    assert autotune_mod.store_plan(str(corrupt), other)
+    assert autotune_mod.load_cached_plan(str(corrupt), "k2") is not None
+    assert autotune_mod.load_cached_plan(str(corrupt), "k3") is not None
+
+
+# ------------------------------------------------------- explain + plan API
+
+def test_explain_schema_search_and_cached(searched):
+    plan, _ = searched
+    text = plan.explain()
+    head = text.splitlines()[0]
+    assert "candidates" in head and "probed" in head and plan.cache_key in head
+    assert "ms/step" in text and "<- winner" in text
+    assert ("pruned:" in text) or ("not probed" in text)
+    # A cache-loaded plan (no candidate table) still explains itself.
+    warm = TunedPlan.from_dict(plan.to_dict())
+    warm.from_cache = True
+    warm.cache_key = plan.cache_key
+    assert "plan cache" in warm.explain()
+    # to_dict round-trips the knobs.
+    assert TunedPlan.from_dict(plan.to_dict()).knobs_dict() == plan.knobs_dict()
+
+
+def test_candidate_name_and_builder_spec_roundtrip():
+    c = Candidate({"name": "PS", "kwargs": {"sync": False}}, unroll=1,
+                  asynchronous=True, overlap=False)
+    assert c.name == "PS[async,overlap=0]"
+    from autodist_tpu.strategy import PS
+    assert isinstance(c.make_builder(), PS)
+    with pytest.raises(ValueError, match="unknown builder"):
+        autotune_mod.builder_from_spec({"name": "NoSuchBuilder"})
+
+
+# --------------------------------------------------------- session plumbing
+
+def test_session_tune_applies_plan_and_records_it(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTODIST_PLAN_CACHE", str(tmp_path / "pc.json"))
+    ad = AutoDist(strategy_builder="autotune")
+    runner = ad.create_distributed_session(
+        _loss, _params(), optax.sgd(0.1), example_batch=_batch())
+    plan = runner.tuned_plan
+    assert plan is not None and plan.measured_steps_per_s > 0
+    assert type(runner.plan) is not type(None)  # session built and usable
+    state = runner.init(_params())
+    state, loss = runner.run(state, _batch())
+    assert np.isfinite(float(loss))
+    # The applied plan rides the profile document and recorder manifest.
+    applied = profiling.applied_plan()
+    assert applied and applied["cache_key"] == plan.cache_key
+    assert applied["knobs"]["unroll"] == plan.unroll
+    profiling.enable()
+    doc = profiling.profile_document()
+    assert doc["plan"]["name"] == plan.name
+    manifest = telemetry.build_manifest("test")
+    assert manifest["plan"]["cache_key"] == plan.cache_key
+    # train() adopts the tuned unroll when none is passed.
+    from autodist_tpu import train
+    final = train(runner, _params(), lambda i: _batch(), steps=plan.unroll,
+                  log_every=0)
+    assert int(final.step) == plan.unroll
+
+
+def test_session_warm_cache_zero_probe_steps(tmp_path, monkeypatch):
+    """The acceptance pin: a second launch with a warm cache builds its
+    session without a single probe step or compile probe."""
+    monkeypatch.setenv("AUTODIST_PLAN_CACHE", str(tmp_path / "pc.json"))
+    ad = AutoDist(strategy_builder="autotune")
+    ad.create_distributed_session(_loss, _params(), optax.sgd(0.1),
+                                  example_batch=_batch())
+
+    def boom(*a, **k):
+        raise AssertionError("warm launch ran a probe")
+
+    monkeypatch.setattr(autotune_mod, "measure_candidate", boom)
+    monkeypatch.setattr(autotune_mod, "_probe_base_costs", boom)
+    ad2 = AutoDist(strategy_builder="autotune")
+    runner2 = ad2.create_distributed_session(
+        _loss, _params(), optax.sgd(0.1), example_batch=_batch())
+    assert runner2.tuned_plan.from_cache
+
+
+def test_session_degrades_to_default_builder_when_search_fails(monkeypatch):
+    """Tuning is an optimization: a search that raises (backend with no
+    cost analysis, every probe failing) falls back to the default builder
+    with a warning instead of killing the launch."""
+    def boom(*a, **k):
+        raise RuntimeError("no candidate could be compile-probed")
+
+    monkeypatch.setattr(autotune_mod, "autotune", boom)
+    ad = AutoDist(strategy_builder="autotune")
+    runner = ad.create_distributed_session(
+        _loss, _params(), optax.sgd(0.1), example_batch=_batch())
+    assert runner.tuned_plan is None
+    assert type(ad._strategy_builder) is PSLoadBalancing  # the default
+    state = runner.init(_params())
+    state, loss = runner.run(state, _batch())
+    assert np.isfinite(float(loss))
+
+
+def test_measure_candidate_argument_errors_raise(monkeypatch):
+    """Argument errors surface as the caller's mistake, not as recorded
+    candidate failures (the failure-skip guard is for candidate faults)."""
+    from autodist_tpu.strategy import measure_candidate
+    with pytest.raises(ValueError, match="warmup_steps"):
+        measure_candidate(AllReduce(), _loss, _params(), optax.sgd(0.1),
+                          _batch(), warmup_steps=0)
+    with pytest.raises(ValueError, match="unroll"):
+        measure_candidate(AllReduce(), _loss, _params(), optax.sgd(0.1),
+                          _batch(), unroll=0)
+
+
+def test_session_tune_false_by_default_and_bad_name(monkeypatch):
+    monkeypatch.delenv("AUTODIST_TUNE", raising=False)
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(
+        _loss, _params(), optax.sgd(0.1), example_batch=_batch())
+    assert runner.tuned_plan is None
+    with pytest.raises(ValueError, match="autotune"):
+        AutoDist(strategy_builder="fastest_please")
+
+
+def test_tune_telemetry_gauges_booked(searched):
+    """The search books the tune.* gauges (the module fixture's real search
+    already ran — instruments book whether or not telemetry is enabled) and
+    a warm relaunch counts a cache hit. Counters are process-global and
+    monotonic: assert DELTAS, not totals."""
+    plan, cache = searched
+    snap = telemetry.snapshot()
+    # Gauges are last-write-wins across the process (other tests in this
+    # file also search): pin presence + sanity, not the fixture's exact run.
+    assert snap["tune.candidates"] > 0
+    assert snap["tune.probed"] >= 1
+    assert snap["tune.best_steps_per_s"] > 0
+    assert snap["tune.search_s"] > 0
+    assert snap.get("tune.cache_miss", 0) >= 1
+    before = snap.get("tune.cache_hit", 0)
+    _fast_autotune(plan_cache=cache)
+    assert telemetry.snapshot()["tune.cache_hit"] - before == 1
