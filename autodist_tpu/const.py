@@ -117,6 +117,21 @@ KNOWN_FLAGS = {
                           "stage-1 survivors are measured with real steps",
     "AUTODIST_TUNE_BUDGET": "autotuner stage-1 budget: cap on enumerated "
                             "candidates ranked by the calibrated cost model",
+    "AUTODIST_METRICS_DIR": "metric-history shard directory: each registry "
+                            "sample appends one JSONL line (rotation-capped "
+                            "shards); also arms boundary sampling",
+    "AUTODIST_METRICS_PORT": "OpenMetrics/Prometheus scrape endpoint port "
+                             "(/metrics + /healthz); empty/0 = no endpoint",
+    "AUTODIST_METRICS_INTERVAL_S": "min seconds between metric-history "
+                                   "samples; > 0 also starts the wall-clock "
+                                   "sampler thread (0 = boundary-driven "
+                                   "only, 10s throttle)",
+    "AUTODIST_ALERT_RULES": "alert rule source: a JSON file path or inline "
+                            "JSON, overlaid on the shipped default rules; "
+                            "setting it arms boundary sampling",
+    "AUTODIST_ALERT_ACTION": "what a firing alert does: 'warn' (log), "
+                             "'record' (flight-recorder snapshot), 'halt' "
+                             "(raise AlertHalt out of the sampling loop)",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -239,6 +254,16 @@ _ENV_DEFAULTS = {
     "AUTODIST_PLAN_CACHE": "",
     "AUTODIST_TUNE_TOPK": 3,
     "AUTODIST_TUNE_BUDGET": 32,
+    # Fleet metrics plane (autodist_tpu/telemetry/{history,openmetrics,
+    # alerts}.py): on-disk metric history, the Prometheus-format scrape
+    # endpoint, and declarative SLO/drift alert rules evaluated on every
+    # history sample. All off by default; any of METRICS_DIR /
+    # METRICS_INTERVAL_S / ALERT_RULES arms the boundary sampler.
+    "AUTODIST_METRICS_DIR": "",
+    "AUTODIST_METRICS_PORT": "",
+    "AUTODIST_METRICS_INTERVAL_S": 0.0,
+    "AUTODIST_ALERT_RULES": "",
+    "AUTODIST_ALERT_ACTION": "warn",
 }
 
 class ENV(enum.Enum):
@@ -288,6 +313,11 @@ class ENV(enum.Enum):
     AUTODIST_PLAN_CACHE = "AUTODIST_PLAN_CACHE"
     AUTODIST_TUNE_TOPK = "AUTODIST_TUNE_TOPK"
     AUTODIST_TUNE_BUDGET = "AUTODIST_TUNE_BUDGET"
+    AUTODIST_METRICS_DIR = "AUTODIST_METRICS_DIR"
+    AUTODIST_METRICS_PORT = "AUTODIST_METRICS_PORT"
+    AUTODIST_METRICS_INTERVAL_S = "AUTODIST_METRICS_INTERVAL_S"
+    AUTODIST_ALERT_RULES = "AUTODIST_ALERT_RULES"
+    AUTODIST_ALERT_ACTION = "AUTODIST_ALERT_ACTION"
 
     @property
     def val(self):

@@ -632,6 +632,18 @@ class PSServer:
             watchdog_interval = const.ENV.AUTODIST_WATCHDOG_SEC.val
         self._watchdog = _StragglerWatchdog(self, watchdog_interval) \
             if watchdog else None
+        # Scrape endpoint: AUTODIST_METRICS_PORT attaches /metrics+/healthz
+        # to this process (process-global: one bind even when a train loop
+        # or InferenceServer shares the process; no-op when the flag is off).
+        from autodist_tpu.telemetry import history as _history
+        from autodist_tpu.telemetry import openmetrics as _openmetrics
+        _openmetrics.maybe_serve()
+        # Metric history: a PS chief may have NO train-loop boundary or
+        # scheduler round (applies arrive over the wire), so its only
+        # sampling beat is the wall-clock thread — arm it here so the
+        # worker_stalled rule actually watches the last-seen gauges this
+        # very process books. No-op when the metrics flags are off.
+        _history.maybe_arm()
         logging.info("PSServer listening on %s:%d", *self._server.server_address)
 
     @property
@@ -688,6 +700,10 @@ class PSServer:
         # ONCE (adtop reads `events`, falling back to the stats plane's
         # `anomalies` key) — an aliased copy doubles the poll payload.
         snap["events"] = snap.pop("anomalies", [])
+        # Alert plane: active + recently-resolved rule firings (a stable
+        # empty shell when alerting never armed — pollers keep one schema).
+        from autodist_tpu.telemetry import alerts as _alerts
+        snap["alerts"] = _alerts.alerts_snapshot()
         controller = getattr(self._runner, "controller", None)
         if controller is not None:
             bound = controller.bound
@@ -861,7 +877,13 @@ class PSClientError(RuntimeError):
 
 
 class _PSClient:
-    def __init__(self, address, connect_timeout: float = 60.0):
+    def __init__(self, address, connect_timeout: float = 60.0,
+                 read_timeout: Optional[float] = None):
+        """``connect_timeout`` bounds the whole retry-until-up loop AND each
+        attempt (a SYN-dropping peer must not park one attempt for longer
+        than the caller's total budget — the adfleet liveness-probe case);
+        ``read_timeout`` optionally bounds each reply wait (default None:
+        workers park on the gate for as long as the protocol says)."""
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             address = (host, int(port))
@@ -871,13 +893,15 @@ class _PSClient:
         deadline = time.monotonic() + connect_timeout
         while True:
             try:
-                self._sock = socket.create_connection(address, timeout=10)
+                attempt = min(10.0, max(0.1, deadline - time.monotonic()))
+                self._sock = socket.create_connection(address,
+                                                      timeout=attempt)
                 break
             except OSError:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.2)
-        self._sock.settimeout(None)
+        self._sock.settimeout(read_timeout)
         self._lock = threading.Lock()
         self._pool = _RecvBuffer()
         # Wire accounting (payload bytes/messages both directions + codec

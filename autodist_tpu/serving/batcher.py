@@ -303,7 +303,23 @@ class _BatcherBase:
             req.finish(error="server shutting down")
 
     def _loop(self):
+        from autodist_tpu.telemetry import alerts as _alerts
+        from autodist_tpu.telemetry import history as _history
         while not self._stop.is_set():
+            # Metric-history tick between scheduler rounds: serving
+            # processes have no train-loop boundary, so the SLO histograms'
+            # series (and the burn-rate alert windows over them) sample
+            # here. Throttled to min_interval_s inside maybe_sample; the
+            # un-armed cost is two module-global reads per round. A halt
+            # alert cannot stop a loop that owns live requests — log it,
+            # keep serving (the gauges/events are booked for pollers).
+            try:
+                _history.maybe_sample(reason="serve_round")
+            except _alerts.AlertHalt as e:
+                from autodist_tpu.utils import logging as _logging
+                _logging.warning("serving: %s (AUTODIST_ALERT_ACTION=halt "
+                                 "does not stop the scheduler loop; drain "
+                                 "via the router instead)", e)
             if not self.run_once():
                 with self._work:
                     if not self._waiting and not self._stop.is_set():

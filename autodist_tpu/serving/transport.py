@@ -128,6 +128,10 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        # Scrape endpoint: AUTODIST_METRICS_PORT attaches /metrics+/healthz
+        # to the serving process (process-global; no-op when the flag is off).
+        from autodist_tpu.telemetry import openmetrics as _openmetrics
+        _openmetrics.maybe_serve()
         logging.info("InferenceServer (%s batcher, %s mode) listening on "
                      "%s:%d", batcher.kind, batcher.config.mode,
                      *self._server.server_address)
@@ -160,6 +164,10 @@ class InferenceServer:
         snap["kind"] = "serve"
         snap["engine"] = self._batcher.kind
         snap["in_flight"] = self._batcher.in_flight_snapshot()
+        # Alert plane: same section (and same empty-shell contract) as the
+        # PS status — one console renders both endpoint kinds.
+        from autodist_tpu.telemetry import alerts as _alerts
+        snap["alerts"] = _alerts.alerts_snapshot()
         return snap
 
     def _wait(self, req, timeout) -> tuple:

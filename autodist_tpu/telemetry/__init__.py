@@ -39,6 +39,17 @@ Three planes, one subsystem (docs/usage/observability.md):
   two profiles and the cost model predicts step time from static costs
   plus a calibration fitted from one run.
 
+- **Fleet metrics plane** (:mod:`autodist_tpu.telemetry.history` /
+  :mod:`openmetrics` / :mod:`alerts`) — ``AUTODIST_METRICS_DIR`` retains a
+  timestamped registry series (in-memory ring + rotation-capped JSONL
+  shards), ``AUTODIST_METRICS_PORT`` serves Prometheus-format ``/metrics``
+  + ``/healthz`` from any trainer chief / PSServer / InferenceServer
+  process, and ``AUTODIST_ALERT_RULES`` evaluates declarative
+  threshold/burn-rate/drift rules on every sample (firing books
+  ``alert.active.*`` gauges, emits ``alert`` events, triggers the flight
+  recorder, and honors ``AUTODIST_ALERT_ACTION``); ``tools/adfleet.py``
+  merges ``status`` across N endpoints into one fleet screen.
+
 Everything is OFF by default; ``AUTODIST_TELEMETRY=1`` (or
 :func:`telemetry.enable`) turns recording on. Disabled-mode instrumentation
 costs one attribute check per span (gated in ``bench.py
@@ -46,6 +57,8 @@ costs one attribute check per span (gated in ``bench.py
 per train step (``bench.py --health-overhead`` gates the enabled side).
 """
 
+from autodist_tpu.telemetry import alerts, history, openmetrics
+from autodist_tpu.telemetry.alerts import AlertEngine, AlertHalt, AlertRule
 from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
                                             dump_events_jsonl,
                                             dump_spans_jsonl,
@@ -59,10 +72,12 @@ from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
                                            sample_device_memory)
 from autodist_tpu.telemetry.health import (HealthConfig, HealthHalt,
                                            HealthMonitor)
+from autodist_tpu.telemetry.history import MetricsHistory
 from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                             Registry, counter, event, events,
-                                            gauge, histogram, registry,
-                                            snapshot)
+                                            gauge, histogram, merge_histograms,
+                                            quantile, registry, snapshot)
+from autodist_tpu.telemetry.openmetrics import MetricsExporter
 from autodist_tpu.telemetry import costmodel, profiling
 from autodist_tpu.telemetry.profiling import (peak_spec, profile_document,
                                               write_profile)
@@ -88,4 +103,7 @@ __all__ = [
     "build_manifest",
     "profiling", "costmodel", "peak_spec", "profile_document",
     "write_profile",
+    "alerts", "history", "openmetrics",
+    "AlertEngine", "AlertHalt", "AlertRule", "MetricsHistory",
+    "MetricsExporter", "quantile", "merge_histograms",
 ]

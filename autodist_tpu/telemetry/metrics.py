@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
            "counter", "gauge", "histogram", "snapshot", "event", "events",
-           "family_buckets"]
+           "family_buckets", "quantile", "merge_histograms"]
 
 Number = Union[int, float]
 
@@ -169,6 +169,54 @@ class Histogram:
         return "{" + body + "}"
 
 
+def quantile(hist: Dict[str, Number], q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile of a histogram SNAPSHOT dict (the
+    ``le:<bound>`` + ``count`` wire form every histogram ships).
+
+    This is the ONE bucket-interpolation everybody uses — the alert engine's
+    burn-rate predicate, ``tools/adtop.py``'s serving SLO line, and
+    ``tools/adfleet.py``'s fleet aggregation — so three consumers can never
+    drift apart on what "p99" means. Linear interpolation inside the winning
+    bucket (the first bucket's lower edge is 0, clamped to the edge when the
+    edge is negative); a quantile landing in the ``+inf`` overflow bucket
+    returns the largest finite edge — a LOWER bound, which is the honest
+    answer a fixed-bucket histogram can give. Returns None for an empty
+    histogram (or a non-histogram dict)."""
+    try:
+        total = hist["count"]
+    except (TypeError, KeyError):
+        return None
+    if not total:
+        return None
+    edges = sorted((float(k[3:]), v) for k, v in hist.items()
+                   if k.startswith("le:") and k != "le:+inf")
+    target = max(0.0, min(1.0, q)) * total
+    seen = 0.0
+    lower = None
+    for bound, n in edges:
+        if n and seen + n >= target:
+            lo = min(0.0, bound) if lower is None else lower
+            return lo + (bound - lo) * (target - seen) / n
+        seen += n
+        lower = bound
+    return edges[-1][0] if edges else None
+
+
+def merge_histograms(snaps: Sequence[Dict[str, Number]]) -> Dict[str, Number]:
+    """Element-wise sum of histogram snapshot dicts — the cross-process
+    aggregation (identical edges merge exactly; a snapshot with different
+    edges contributes its buckets verbatim, which keeps :func:`quantile`
+    a defensible estimate rather than raising mid-console-render)."""
+    out: Dict[str, Number] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for k, v in snap.items():
+            if isinstance(v, (int, float)):
+                out[k] = out.get(k, 0) + v
+    return out
+
+
 # Structured events kept per registry (newest win; anomaly records from the
 # PS watchdog, not a general log sink).
 _EVENT_RING = 256
@@ -210,13 +258,19 @@ class Registry:
                   buckets: Optional[Sequence[Number]] = None) -> Histogram:
         return self._get(name, Histogram, buckets or family_buckets(name))
 
+    def instruments(self) -> List[Tuple[str, object]]:
+        """A point-in-time, name-sorted copy of the live instrument objects
+        — the public walk :meth:`snapshot` and the OpenMetrics renderer
+        share (renderers need the instrument TYPE, which the snapshot's
+        plain values erase)."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
     def snapshot(self) -> Dict[str, object]:
         """``{name: value-or-histogram-dict}``, keys sorted — deterministic
         for a given set of recorded values regardless of registration order,
         and wire-encodable as-is (the ``stats`` opcode ships it)."""
-        with self._lock:
-            items = sorted(self._metrics.items())
-        return {name: m.snapshot() for name, m in items}
+        return {name: m.snapshot() for name, m in self.instruments()}
 
     def event(self, name: str, **fields) -> Dict[str, object]:
         """Record a structured event (``{"name", "t_wall_s", **fields}``) into

@@ -85,6 +85,16 @@ def build_manifest(reason: str, seq: Optional[int] = None) -> Dict[str, Any]:
             manifest["plan"] = plan
     except Exception:   # diagnostics must never fail the snapshot
         pass
+    try:
+        # Active alerts at capture time (the non-creating accessor: a
+        # snapshot must not grow an alert engine as a side effect) — an
+        # alert-triggered snapshot carries WHICH rule fired and its numbers.
+        from autodist_tpu.telemetry import alerts as _alerts
+        active = _alerts.active_alerts()
+        if active:
+            manifest["alerts"] = active
+    except Exception:   # diagnostics must never fail the snapshot
+        pass
     return manifest
 
 

@@ -17,6 +17,8 @@ from autodist_tpu import const, telemetry
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.runner import TrainState
 from autodist_tpu.telemetry import health as _health
+from autodist_tpu.telemetry import history as _history
+from autodist_tpu.telemetry import openmetrics as _openmetrics
 from autodist_tpu.telemetry import profiling as _profiling
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import ThroughputMeter
@@ -131,6 +133,10 @@ def train(runner, params: PyTree,
         raise ValueError("eval_every needs an eval_batch")
     if is_chief is None:
         is_chief = const.is_chief_process()
+    # Scrape endpoint: AUTODIST_METRICS_PORT attaches /metrics + /healthz to
+    # the trainer process too (PSServer/InferenceServer processes attach in
+    # their constructors; the process-global exporter binds once either way).
+    _openmetrics.maybe_serve()
     # Sharded (multi-process SPMD) saves are collective: every process must
     # participate — each writes the shards it owns; the Saver itself gates
     # manifest/rotation to process 0. Chief-only gating remains for
@@ -191,6 +197,18 @@ def train(runner, params: PyTree,
         if _profiling.active():
             _profiling.observe_period(int(final_state.step),
                                       require_steps=True)
+        # End-of-run history flush (forced past the throttle): a run shorter
+        # than one min_interval_s window still leaves at least one sample —
+        # and its final alert tick — in the ring/shards. AFTER the closing
+        # observe_period so the sample carries the tail period's gauges;
+        # BEFORE the final save so a halt-action alert stops us with the
+        # state unsaved-but-LIVE on the exception, exactly like HealthHalt.
+        try:
+            _history.maybe_sample(int(final_state.step), reason="final",
+                                  force=True)
+        except telemetry.AlertHalt as e:
+            e.state = final_state
+            raise
         # Final save stays synchronous: train() returning means the state is
         # durably on disk (save() joins any in-flight periodic write first).
         if saver is not None and save_participant and int(final_state.step) > start:
@@ -264,6 +282,12 @@ def train(runner, params: PyTree,
                              meter.last_readback_s,
                              f" | {stats.format_line()}" if stats else "",
                              _profiling.format_attr_line(attr))
+                # The period's throughput as a gauge: the fleet console
+                # (tools/adfleet.py) compares steps/s across processes off
+                # the status opcode, so the rate must live in the registry,
+                # not just the log line. One gauge set per log boundary.
+                telemetry.gauge("train.steps_per_s").set(
+                    round(rate / meter.batch_size, 4))
                 if telemetry.enabled():
                     # Memory gauges first so the snapshot emitted below
                     # carries this boundary's live-buffer/HBM readings (and
@@ -274,6 +298,18 @@ def train(runner, params: PyTree,
                     _observe_health(monitor, runner, step_i + 1,
                                     jax.device_get(pending_losses), state)
                     pending_losses = []
+                # Metric-history sample LAST at the boundary, so the sample
+                # (and the alert rules it evaluates) sees this period's
+                # attr/mfu/health/throughput gauges. An AlertHalt under
+                # AUTODIST_ALERT_ACTION=halt propagates from here — the
+                # train loop is the sampler a halt can actually stop — with
+                # the LIVE TrainState attached (the HealthHalt contract:
+                # a halt leaves the state checkpointable, not discarded).
+                try:
+                    _history.maybe_sample(step_i + 1)
+                except telemetry.AlertHalt as e:
+                    e.state = state
+                    raise
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
         if (eval_every and (step_i + 1) % eval_every == 0
@@ -401,6 +437,11 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                              step_i, last, rate, queue_depth,
                              meter.last_readback_s,
                              _profiling.format_attr_line(attr))
+                # Steps/s gauge for the fleet console (same contract as the
+                # per-step loop: the registry carries the rate, not just
+                # the log line).
+                telemetry.gauge("train.steps_per_s").set(
+                    round(rate / meter.batch_size, 4))
                 if telemetry.enabled():
                     # Memory gauges first so the emitted snapshot carries
                     # this boundary's live-buffer/HBM readings (and the
@@ -412,6 +453,14 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                                            in jax.device_get(pending_losses)])
                     _observe_health(monitor, runner, step_i, flat, state)
                     pending_losses = []
+                # History sample last: the alert tick sees this boundary's
+                # freshly-booked gauges (AlertHalt propagates with the live
+                # state attached, like the per-step loop).
+                try:
+                    _history.maybe_sample(step_i)
+                except telemetry.AlertHalt as e:
+                    e.state = state
+                    raise
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)
         if eval_every and step_i % eval_every == 0:

@@ -179,6 +179,12 @@ class ThroughputMeter:
         self.last_readback_s = 0.0
         self.history: List[float] = []
 
+    @property
+    def batch_size(self) -> int:
+        """Examples per step — what divides a period's examples/s rate back
+        into the steps/s the fleet console compares across processes."""
+        return self._batch_size
+
     def step(self, sync=None) -> Optional[float]:
         """Record one completed step; returns the period rate when a period ends.
 

@@ -576,6 +576,144 @@ def attr_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
     return result
 
 
+def metrics_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
+    """Fleet-metrics-plane cost micro-bench (the CPU transformer micro-model,
+    host-dispatch-bound — where any per-boundary cost is most visible):
+
+    - steps/s through ``runner.run`` with the plane DISABLED (production
+      default: no history, no alerting) and ENABLED (a MetricsHistory with
+      JSONL shards + the SHIPPED alert rule set sampling at every
+      ``log_every`` boundary, plus one OpenMetrics render per boundary —
+      the worst case of a scraper polling exactly at boundary rate), best
+      of ``rounds`` interleaved rounds;
+    - the DIRECT enabled-side costs, machine-relative so they gate
+      everywhere: ``sample_ms`` (one registry snapshot + ring append +
+      shard line + full default-rule alert evaluation) and ``render_ms``
+      (one exposition render of the populated registry), combined as
+      ``overhead_pct`` = (sample_ms + render_ms) / log_every over the
+      measured disabled step time. This is the gated number: the
+      ``metrics_overhead`` row in PERF_BASELINE.json carries
+      ``max_overhead_pct`` (2.0) — the plane growing past ~2% of a
+      host-bound step means sampling stopped being one snapshot walk.
+    """
+    import shutil
+    import sys
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry import alerts, history, openmetrics
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(loss_fn, params, optax.adam(1e-3),
+                                           example_batch=batch)
+    state = runner.init(params)
+
+    tmp = tempfile.mkdtemp(prefix="metrics_bench_")
+    engine = alerts.AlertEngine(rules=alerts.load_rules(""), action="warn")
+    hist = history.MetricsHistory(out_dir=tmp, min_interval_s=0.0,
+                                  engine=engine)
+
+    def measure(n, boundary=False):
+        nonlocal state
+        loss = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            state, loss = runner.run(state, batch)
+            if boundary and (i + 1) % log_every == 0:
+                # The boundary work a real armed train() period pays, AT
+                # the period rate — sample (+ alert tick + shard line) and
+                # one scrape-rate render per log_every steps, inside the
+                # timed window so the pair covers the WHOLE enabled cost.
+                hist.sample(step=i + 1)
+                openmetrics.render()
+        _ = jax.device_get(loss)   # completion fence
+        return n / (time.perf_counter() - t0)
+
+    measure(10)                    # compile + warmup
+    measure(log_every, boundary=True)   # warm the boundary path too
+    best = {"disabled": 0.0, "enabled": 0.0}
+    for _ in range(rounds):        # interleaved: load noise hits both sides
+        best["disabled"] = max(best["disabled"], measure(steps))
+        best["enabled"] = max(best["enabled"], measure(steps, boundary=True))
+
+    # Direct boundary costs (min of rounds — load stretches, never shrinks).
+    sample_ms = render_ms = math.inf
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        hist.sample()
+        sample_ms = min(sample_ms, (time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        text = openmetrics.render()
+        render_ms = min(render_ms, (time.perf_counter() - t0) * 1e3)
+    n_shards = len(hist.shards())
+    hist.close()
+    shutil.rmtree(tmp, ignore_errors=True)   # CI runs this every pass
+
+    step_ms = 1e3 / best["disabled"]
+    overhead_pct = 100.0 * (sample_ms + render_ms) / log_every / step_ms
+
+    result = {
+        "metric": f"metrics_overhead ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size}, "
+                  f"log_every {log_every})",
+        "unit": "steps/s",
+        "rows": {"disabled": round(best["disabled"], 2),
+                 "enabled": round(best["enabled"], 2)},
+        "enabled_vs_disabled": round(best["enabled"] / best["disabled"], 4),
+        "sample_ms": round(sample_ms, 4),
+        "render_ms": round(render_ms, 4),
+        "render_bytes": len(text),
+        "rules": len(engine.rules),
+        "shards": n_shards,
+        "overhead_pct": round(overhead_pct, 4),
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("metrics_overhead")
+        if recorded:
+            max_pct = recorded.get("max_overhead_pct", 2.0)
+            if overhead_pct > max_pct:
+                print(f"WARNING: the fleet metrics plane costs "
+                      f"{overhead_pct:.3f}% of a host-bound step, above the "
+                      f"{max_pct}% gate — history sampling or the exporter "
+                      f"render got costlier (see PERF_BASELINE.json "
+                      f"metrics_overhead)", file=sys.stderr)
+            floor = recorded.get("enabled_vs_disabled_floor")
+            if (floor and recorded.get("platform") == platform
+                    and result["enabled_vs_disabled"] < floor):
+                print(f"WARNING: metrics-enabled steps/s is "
+                      f"{result['enabled_vs_disabled']:.2f}x the disabled "
+                      f"rate, below the recorded {floor:.2f}x floor (see "
+                      f"PERF_BASELINE.json metrics_overhead)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["disabled"],
+                        "unit": "steps/s",
+                        "sample_ms": result["sample_ms"],
+                        "render_ms": result["render_ms"],
+                        "overhead_pct": result["overhead_pct"]})
+    return result
+
+
 def trace_pull_overhead(rounds: int = 5):
     """Cluster-trace pull cost micro-bench: fill the span ring to its full
     capacity (AUTODIST_TELEMETRY_RING, default 65536 spans) and measure
@@ -1195,6 +1333,14 @@ def main(argv=None):
              "run's profile JSON into AUTODIST_PROFILE_DIR when set (the "
              "adprof self-diff smoke reads it)")
     parser.add_argument(
+        "--metrics-overhead", action="store_true",
+        help="measure the fleet metrics plane's cost on the CPU micro-model: "
+             "steps/s with the plane disabled vs enabled (history sampling + "
+             "shipped alert rules + one OpenMetrics render per boundary) "
+             "plus the direct per-boundary sample/render costs, gated "
+             "against max_overhead_pct in the PERF_BASELINE.json "
+             "metrics_overhead row")
+    parser.add_argument(
         "--trace-pull-overhead", action="store_true",
         help="measure the cluster trace plane's pull cost: fill the span "
              "ring to capacity, report the chief-side snapshot+encode stall "
@@ -1240,6 +1386,9 @@ def main(argv=None):
         return
     if args.attr_overhead:
         attr_overhead()
+        return
+    if args.metrics_overhead:
+        metrics_overhead()
         return
     if args.trace_pull_overhead:
         trace_pull_overhead()

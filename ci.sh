@@ -113,6 +113,11 @@ AUTODIST_PROFILE_DIR="$ADPROF_SMOKE_DIR" python bench.py --attr-overhead
 ADPROF_SMOKE=$(ls "$ADPROF_SMOKE_DIR"/profile-*.json | head -1)
 python tools/adprof.py "$ADPROF_SMOKE" "$ADPROF_SMOKE" --threshold 5
 rm -rf "$ADPROF_SMOKE_DIR"
+# Fleet metrics plane gate: a history sample (registry snapshot + JSONL
+# shard line + the shipped alert-rule tick) plus one OpenMetrics render,
+# amortized over a log period, must stay within max_overhead_pct of a
+# host-bound step (metrics_overhead row).
+python bench.py --metrics-overhead
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead

@@ -351,6 +351,26 @@ def test_status_and_record_opcodes_over_loopback(tmp_path):
         assert "anomalies" not in status
         assert status["registry"]["train.mfu"] == 0.28
         assert status["registry"]["train.attr.compute"] == 0.61
+        # The PR 11 alerts section: a stable empty shell when the alert
+        # plane never armed, the live active/resolved records when it did.
+        assert status["alerts"] == {"active": [], "resolved": [],
+                                    "rules": 0, "action": ""}
+        from autodist_tpu.telemetry import alerts as _alerts
+        eng = _alerts.AlertEngine(rules=[_alerts.AlertRule(
+            name="pin", kind="threshold", metric="train.mfu", op=">",
+            value=0.1)], action="warn")
+        _alerts.set_engine(eng)
+        try:
+            from autodist_tpu.telemetry import history as _history
+            h = _history.MetricsHistory(out_dir="", min_interval_s=0.0,
+                                        engine=eng)
+            h.sample()
+            status = remote.status()
+            assert [a["rule"] for a in status["alerts"]["active"]] == ["pin"]
+            assert status["alerts"]["action"] == "warn"
+            assert "anomalies" not in status    # still renamed, not aliased
+        finally:
+            _alerts.set_engine(None)
         json.dumps(status)                  # crossed the wire: plain data
         path = remote.record("operator_asked")
         assert path and os.path.isdir(path)
@@ -390,11 +410,21 @@ def _adtop():
 
 
 def test_adtop_once_renders_loopback_status(capsys):
+    from autodist_tpu.telemetry import alerts as _alerts
+    from autodist_tpu.telemetry import history as _history
     telemetry.gauge("train.health.grad_norm").set(2.5)
     telemetry.gauge("train.mfu").set(0.283)
     telemetry.gauge("train.attr.compute").set(0.61)
     telemetry.gauge("train.attr.data_wait").set(0.07)
     telemetry.event("ps.anomaly.stall", worker=0, last_seen_s=42.0)
+    # An active alert must render on its own console line (the PR 11
+    # status-section satellite).
+    eng = _alerts.AlertEngine(rules=[_alerts.AlertRule(
+        name="mfu_floor", kind="threshold", metric="train.mfu", op=">",
+        value=0.1)], action="warn")
+    _alerts.set_engine(eng)
+    _history.MetricsHistory(out_dir="", min_interval_s=0.0,
+                            engine=eng).sample()
     server, addr = _loopback(watchdog=False)
     try:
         server._runner.controller.register(0)
@@ -410,10 +440,12 @@ def test_adtop_once_renders_loopback_status(capsys):
         assert "mfu 28.3%" in out
         assert "comp .61" in out and "data .07" in out
         assert "ps.anomaly.stall" in out
+        assert "alerts   1 active" in out and "mfu_floor" in out
         # --raw ships the JSON payload verbatim.
         assert ad.main([addr, "--raw"]) == 0
         assert json.loads(capsys.readouterr().out)["kind"] == "ps"
     finally:
+        _alerts.set_engine(None)
         server.close()
 
 

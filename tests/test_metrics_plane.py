@@ -1,0 +1,834 @@
+"""Fleet metrics plane: history, OpenMetrics exposition, alerts, adfleet.
+
+Covers the PR 11 contract end to end (docs/usage/observability.md "Metric
+history" / "OpenMetrics endpoint" / "Alert rules" / "Fleet console"):
+
+- OpenMetrics/Prometheus text rendering round-trips through a SELF-CONTAINED
+  text-format parser (name sanitization, label escaping, cumulative ``le``
+  buckets, counter ``_total`` monotonicity);
+- ``MetricsHistory``: ring bound, window/series queries, throttling, JSONL
+  shard rotation + retention, the wall-clock sampler thread;
+- every alert predicate kind: threshold (+ for-duration coverage), multi-
+  window burn rate over histogram-delta quantiles, and the tuned-plan drift
+  band (``ref_from="plan"`` against the applied plan's predicted breakdown,
+  ``ref_from="window_max"`` MFU collapse);
+- rule loading from file/inline JSON with same-name override and malformed-
+  rule degradation (warn + skip, never crash the sampling loop);
+- the END-TO-END acceptance pin: an injected data-loader stall inside
+  ``train()`` drifts ``train.attr.data_wait`` past the SHIPPED rule's band ->
+  the alert event fires -> a flight-recorder snapshot lands with the alert in
+  its manifest -> the same process's ``/metrics`` endpoint exposes the
+  ``alert_active`` gauge — NO human action anywhere;
+- ``/metrics`` + ``/healthz`` over loopback HTTP;
+- ``tools/adfleet.py --once/--raw`` against two loopback ``status`` servers
+  (one PS kind, one serve kind) with fleet-aggregated quantiles;
+- the shared quantile helper and the new flag registrations.
+
+Pure in-process host tests — no subprocess spawns (GL008-clean), named to
+sort inside the tier-1 window.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu import AutoDist, const, telemetry, train  # noqa: E402
+from autodist_tpu.strategy import AllReduce  # noqa: E402
+from autodist_tpu.telemetry import (alerts, history, metrics,  # noqa: E402
+                                    openmetrics, profiling, recorder)
+
+
+@pytest.fixture(autouse=True)
+def _plane_reset():
+    """Leave the process-global planes as found: no history, no engine, no
+    exporter, no recorder, empty span/event rings (instruments stay — the
+    registry is additive-only and shared across the suite)."""
+    def reset():
+        history.set_history(None)
+        alerts.set_engine(None)
+        openmetrics.set_exporter(None)
+        recorder.set_recorder(None)
+        profiling.set_applied_plan(None)
+        profiling.disable()
+        telemetry.disable()
+        telemetry.clear()
+        telemetry.registry().clear_events()
+    reset()
+    yield
+    reset()
+
+
+def _fresh_registry():
+    return metrics.Registry()
+
+
+def _mk_history(engine=False, **kw):
+    kw.setdefault("out_dir", "")
+    kw.setdefault("min_interval_s", 0.0)
+    return history.MetricsHistory(engine=engine, **kw)
+
+
+# ------------------------------------------------- OpenMetrics text format
+
+def _parse_exposition(text: str):
+    """A SELF-CONTAINED Prometheus text-format 0.0.4 parser: returns
+    ({name: type}, {(name, frozenset(labels)): value}). Raises on any line
+    the format does not allow — the round-trip test doubles as the
+    "standard-format scrape parses clean" acceptance pin."""
+    types, samples = {}, {}
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            assert mtype in ("counter", "gauge", "histogram", "summary")
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), f"bad comment line: {line!r}"
+            continue
+        m = re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$',
+                     line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labelstr, value = m.groups()
+        assert name_re.match(name)
+        labels = frozenset()
+        if labelstr:
+            pairs = re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                               labelstr)
+            labels = frozenset(pairs)
+        v = float(value.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        samples[(name, labels)] = v
+    return types, samples
+
+
+def test_openmetrics_roundtrip_counters_gauges():
+    reg = _fresh_registry()
+    reg.counter("ps.wire.bytes_sent").inc(1234)
+    reg.gauge("train.mfu").set(0.283)
+    reg.gauge("alert.active").set(2)
+    types, samples = _parse_exposition(openmetrics.render(reg))
+    assert types["ps_wire_bytes_sent_total"] == "counter"
+    assert samples[("ps_wire_bytes_sent_total", frozenset())] == 1234
+    assert types["train_mfu"] == "gauge"
+    assert samples[("train_mfu", frozenset())] == 0.283
+    assert samples[("alert_active", frozenset())] == 2
+
+
+def test_openmetrics_histogram_cumulative_le_buckets():
+    reg = _fresh_registry()
+    h = reg.histogram("serve.latency_s.total", buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.05, 0.3, 2.0):
+        h.observe(v)
+    types, samples = _parse_exposition(openmetrics.render(reg))
+    name = "serve_latency_s_total"
+    assert types[name] == "histogram"
+    # Buckets are CUMULATIVE (the registry's snapshot form is per-bucket —
+    # the renderer must convert or every scraper misreads the histogram).
+    assert samples[(name + "_bucket", frozenset({("le", "0.1")}))] == 2
+    assert samples[(name + "_bucket", frozenset({("le", "0.5")}))] == 3
+    assert samples[(name + "_bucket", frozenset({("le", "1")}))] == 3
+    assert samples[(name + "_bucket", frozenset({("le", "+Inf")}))] == 4
+    assert samples[(name + "_count", frozenset())] == 4
+    assert samples[(name + "_sum", frozenset())] == pytest.approx(2.4)
+
+
+def test_openmetrics_counter_monotonicity_and_name_sanitization():
+    reg = _fresh_registry()
+    c = reg.counter("weird-name.with spaces.9lead")
+    c.inc(1)
+    text1 = openmetrics.render(reg)
+    c.inc(2)
+    text2 = openmetrics.render(reg)
+    _, s1 = _parse_exposition(text1)
+    types, s2 = _parse_exposition(text2)
+    key = [k for k in s1 if k[0].endswith("_total")]
+    assert len(key) == 1   # one sanitized counter, a legal exposition name
+    assert s2[key[0]] >= s1[key[0]]   # counters only go up
+    assert types[key[0][0]] == "counter"
+
+
+def test_openmetrics_escaping_and_special_values():
+    reg = _fresh_registry()
+    reg.gauge("g.inf").set(float("inf"))
+    reg.gauge("g.nan").set(float("nan"))
+    types, samples = _parse_exposition(openmetrics.render(reg))
+    assert samples[("g_inf", frozenset())] == float("inf")
+    assert math.isnan(samples[("g_nan", frozenset())])
+    assert openmetrics._escape_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert openmetrics._escape_help("x\ny") == "x\\ny"
+
+
+# ----------------------------------------------------------- shared quantile
+
+def test_quantile_interpolates_and_bounds():
+    h = metrics.Histogram("q", buckets=(0.1, 0.2, 0.4))
+    for v in [0.05] * 50 + [0.15] * 40 + [0.3] * 10:
+        h.observe(v)
+    snap = h.snapshot()
+    assert metrics.quantile(snap, 0.5) == pytest.approx(0.1)
+    # p99 lands in the (0.2, 0.4] bucket, nine-tenths in: interpolated.
+    assert metrics.quantile(snap, 0.99) == pytest.approx(0.38)
+    # The +inf bucket answers with the largest finite edge (a LOWER bound).
+    h.observe(100.0)
+    assert metrics.quantile(h.snapshot(), 1.0) == pytest.approx(0.4)
+    assert metrics.quantile({}, 0.5) is None
+    assert metrics.quantile({"count": 0}, 0.5) is None
+    assert metrics.quantile(3.0, 0.5) is None    # not a histogram
+    # adtop's SLO path delegates here — the consoles and the alert engine
+    # can never drift on what p99 means.
+    spec = importlib.util.spec_from_file_location(
+        "adtop_q", os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools", "adtop.py"))
+    ad = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ad)
+    assert ad._hist_quantile(snap, 0.5) == metrics.quantile(snap, 0.5)
+
+
+def test_merge_histograms_sums_elementwise():
+    a = {"le:0.1": 2, "le:+inf": 1, "count": 3, "sum": 0.5}
+    b = {"le:0.1": 1, "le:+inf": 0, "count": 1, "sum": 0.05}
+    merged = metrics.merge_histograms([a, b, "not-a-dict"])
+    assert merged == {"le:0.1": 3, "le:+inf": 1, "count": 4, "sum": 0.55}
+
+
+# ------------------------------------------------------------ metric history
+
+def test_history_ring_bound_and_series():
+    g = telemetry.gauge("mp.test.gauge")
+    h = _mk_history(ring=4)
+    for i in range(7):
+        g.set(i)
+        h.sample(step=i)
+    samples = h.samples()
+    assert len(samples) == 4                      # ring bound
+    assert [s["step"] for s in samples] == [3, 4, 5, 6]
+    series = h.series("mp.test.gauge")
+    assert [v for _, v in series] == [3, 4, 5, 6]
+    assert h.latest()["metrics"]["mp.test.gauge"] == 6
+    assert h.window(10_000.0)[-1]["step"] == 6
+
+
+def test_history_maybe_sample_throttles():
+    h = _mk_history(min_interval_s=3600.0)
+    assert h.maybe_sample(step=1) is not None
+    assert h.maybe_sample(step=2) is None          # inside the window
+    assert h.sample(step=3) is not None            # sample() always samples
+    assert len(h.samples()) == 2
+
+
+def test_history_jsonl_shards_rotate_and_retain(tmp_path):
+    d = str(tmp_path / "metrics")
+    h = _mk_history(out_dir=d, shard_lines=2, keep_shards=2)
+    telemetry.gauge("mp.shard.gauge").set(1.25)
+    for i in range(7):
+        h.sample(step=i)
+    shards = h.shards()
+    # 7 samples at 2 lines/shard = 4 shards written, latest-2 retained.
+    assert len(shards) == 2
+    loaded = [rec for p in shards for rec in history.load_history_jsonl(p)]
+    assert [rec["step"] for rec in loaded] == [4, 5, 6]
+    assert loaded[-1]["metrics"]["mp.shard.gauge"] == 1.25
+    assert loaded[-1]["t_wall_s"] > 0
+    # A restarted process EXTENDS the numbering instead of clobbering.
+    h2 = _mk_history(out_dir=d, shard_lines=2, keep_shards=2)
+    h2.sample(step=99)
+    assert history.load_history_jsonl(h2.shards()[-1])[0]["step"] == 99
+    assert len(set(h.shards()) | set(h2.shards())) >= 2
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"no": "metrics key"}\n')
+    with pytest.raises(ValueError, match="sample record"):
+        history.load_history_jsonl(str(bad))
+
+
+def test_history_wall_clock_thread_samples(tmp_path):
+    h = _mk_history(min_interval_s=0.0)
+    h.start_thread(interval_s=0.1)
+    try:
+        deadline = time.monotonic() + 5.0
+        while not h.samples() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert h.samples(), "wall-clock sampler produced no sample in 5s"
+        assert h.samples()[0]["reason"] == "timer"
+    finally:
+        h.close()
+    n = len(h.samples())
+    time.sleep(0.3)
+    assert len(h.samples()) == n                   # close() stopped the beat
+
+
+def test_history_env_arming_and_noop(tmp_path, monkeypatch):
+    # Unarmed: maybe_sample is a no-op and installs nothing.
+    monkeypatch.delenv("AUTODIST_METRICS_DIR", raising=False)
+    monkeypatch.delenv("AUTODIST_ALERT_RULES", raising=False)
+    monkeypatch.delenv("AUTODIST_METRICS_INTERVAL_S", raising=False)
+    history.set_history(None)
+    assert history.maybe_sample(step=1) is None
+    assert history.get_history() is None
+    # AUTODIST_METRICS_DIR arms on the next call after a reset.
+    monkeypatch.setenv("AUTODIST_METRICS_DIR", str(tmp_path / "hist"))
+    monkeypatch.setenv("AUTODIST_METRICS_INTERVAL_S", "0")
+    history.set_history(None)
+    rec = history.maybe_sample(step=2, force=True)
+    assert rec is not None and rec["step"] == 2
+    h = history.get_history()
+    assert h is not None and h.shards()
+
+
+# ------------------------------------------------------------ alert predicates
+
+def test_threshold_predicate_and_wildcard_selector():
+    telemetry.gauge("mp.w.last_seen_s.w0").set(3.0)
+    telemetry.gauge("mp.w.last_seen_s.w1").set(200.0)
+    eng = alerts.AlertEngine(rules=[alerts.AlertRule(
+        name="stalled", kind="threshold", metric="mp.w.last_seen_s.*",
+        op=">", value=120.0)], action="warn")
+    h = _mk_history()
+    fired = eng.evaluate(_sampled(h))
+    assert [f["rule"] for f in fired] == ["stalled"]
+    assert fired[0]["value"] == 200.0              # the WORST worker
+    # Recovery auto-resolves and lands in the resolved ring.
+    telemetry.gauge("mp.w.last_seen_s.w1").set(1.0)
+    assert eng.evaluate(_sampled(h)) == []
+    snap = eng.snapshot()
+    assert snap["active"] == []
+    assert [r["rule"] for r in snap["resolved"]] == ["stalled"]
+    assert telemetry.gauge("alert.active.stalled").value == 0
+    assert telemetry.gauge("alert.active").value == 0
+
+
+def _sampled(h, step=None):
+    h.sample(step=step)
+    return h
+
+
+def test_threshold_for_duration_needs_history_coverage():
+    g = telemetry.gauge("mp.for.gauge")
+    g.set(10.0)
+    eng = alerts.AlertEngine(rules=[alerts.AlertRule(
+        name="sustained", kind="threshold", metric="mp.for.gauge",
+        op=">", value=5.0, for_s=0.2)], action="warn")
+    h = _mk_history()
+    # One fresh sample proves nothing about duration: no firing.
+    assert eng.evaluate(_sampled(h)) == []
+    time.sleep(0.25)
+    # Old-enough agreeing history: fires now.
+    fired = eng.evaluate(_sampled(h))
+    assert [f["rule"] for f in fired] == ["sustained"]
+    # A dip inside the window blocks the NEXT evaluation cycle.
+    eng2 = alerts.AlertEngine(rules=eng.rules, action="warn")
+    h2 = _mk_history()
+    h2.sample()
+    g.set(0.0)
+    h2.sample()
+    g.set(10.0)
+    time.sleep(0.25)
+    assert eng2.evaluate(_sampled(h2)) == []       # the dip is in-window
+
+
+def test_burn_rate_fires_on_both_windows_and_resolves():
+    hist_m = telemetry.histogram("mp.burn.latency_s", buckets=(0.1, 1.0, 5.0))
+    rule = alerts.AlertRule(name="p99burn", kind="burn_rate",
+                            metric="mp.burn.latency_s", q=0.99,
+                            objective_s=1.0, long_s=1.2, short_s=0.6)
+    eng = alerts.AlertEngine(rules=[rule], action="warn")
+    h = _mk_history()
+    h.sample()                                     # window-opening baseline
+    for _ in range(50):
+        hist_m.observe(4.0)                        # bad traffic from t0...
+    time.sleep(0.3)
+    # ...but the LONG window has no coverage yet (span ~0.3 < 0.5 * 1.2):
+    # a 20-second-old process must not page its "5 minute" burn rate.
+    assert eng.evaluate(_sampled(h)) == []
+    for _ in range(50):
+        hist_m.observe(4.0)                        # the incident continues
+    time.sleep(0.3)
+    fired = eng.evaluate(_sampled(h))              # both windows covered now
+    assert [f["rule"] for f in fired] == ["p99burn"]
+    assert fired[0]["p99_long_s"] > 1.0 and fired[0]["p99_short_s"] > 1.0
+    # Traffic recovers: once the SHORT window has aged past the incident its
+    # delta goes healthy and the alert auto-resolves — even though the LONG
+    # window still remembers the bad quantile (the multi-window point: the
+    # long side proves budget burned, the short side proves it stopped).
+    time.sleep(0.65)                               # age past short_s
+    h.sample()                                     # post-incident baseline
+    for _ in range(500):
+        hist_m.observe(0.05)
+    time.sleep(0.3)
+    assert eng.evaluate(_sampled(h)) == []
+    assert eng.snapshot()["active"] == []
+    assert [r["rule"] for r in eng.snapshot()["resolved"]] == ["p99burn"]
+
+
+def test_drift_band_against_applied_plan():
+    profiling.set_applied_plan({
+        "cache_key": "k", "knobs": {"unroll": 4},
+        "predicted": {"step_s": 0.010, "bound": "compute",
+                      "breakdown": {"compute_s": 0.008, "comm_s": 0.001,
+                                    "host_s": 0.001}}})
+    rule = alerts.AlertRule(name="dw_drift", kind="drift",
+                            metric="train.attr.data_wait", ref_from="plan",
+                            band=0.25, direction="above")
+    eng = alerts.AlertEngine(rules=[rule], action="warn")
+    h = _mk_history()
+    g = telemetry.gauge("train.attr.data_wait")
+    g.set(0.10)                                    # inside the band (ref 0)
+    assert eng.evaluate(_sampled(h)) == []
+    g.set(0.60)                                    # the stall: 0.6 > 0+0.25
+    fired = eng.evaluate(_sampled(h))
+    assert [f["rule"] for f in fired] == ["dw_drift"]
+    assert fired[0]["bound"] == 0.0 and fired[0]["band"] == 0.25
+    # comm drifts against its PREDICTED share (0.001/0.010 = 10%), not 0.
+    rule2 = alerts.AlertRule(name="comm_drift", kind="drift",
+                             metric="train.attr.comm", ref_from="plan",
+                             band=0.2, direction="above")
+    eng2 = alerts.AlertEngine(rules=[rule2], action="warn")
+    h2 = _mk_history()
+    gc = telemetry.gauge("train.attr.comm")
+    gc.set(0.25)                                   # 0.25 - 0.1 < 0.2
+    assert eng2.evaluate(_sampled(h2)) == []
+    gc.set(0.35)                                   # 0.35 - 0.1 > 0.2
+    assert [f["rule"] for f in eng2.evaluate(_sampled(h2))] == ["comm_drift"]
+    # With NO plan applied the plan-referenced rule is inert, never wrong.
+    profiling.set_applied_plan(None)
+    eng3 = alerts.AlertEngine(rules=[rule], action="warn")
+    h3 = _mk_history()
+    assert eng3.evaluate(_sampled(h3)) == []
+
+
+def test_drift_window_max_mfu_collapse():
+    rule = alerts.AlertRule(name="mfu_collapse", kind="drift",
+                            metric="train.mfu", ref_from="window_max",
+                            window_s=600.0, band=0.5, relative=True,
+                            direction="below")
+    eng = alerts.AlertEngine(rules=[rule], action="warn")
+    h = _mk_history()
+    g = telemetry.gauge("train.mfu")
+    for v in (0.40, 0.42, 0.41):
+        g.set(v)
+        h.sample()
+    assert eng.evaluate(h) == []                   # healthy plateau
+    g.set(0.10)                                    # collapse: < 0.5 * 0.42
+    fired = eng.evaluate(_sampled(h))
+    assert [f["rule"] for f in fired] == ["mfu_collapse"]
+    assert fired[0]["bound"] == pytest.approx(0.42)
+
+
+# ---------------------------------------------------- rule loading + actions
+
+def test_load_rules_defaults_file_inline_and_degradation(tmp_path, caplog):
+    # Shipped defaults alone.
+    base = alerts.load_rules("")
+    names = {r.name for r in base}
+    assert {"serve_p99_burn", "data_wait_drift", "worker_stalled",
+            "mfu_collapse"} <= names
+    # The shipped burn objective must sit STRICTLY below the latency
+    # family's top finite bucket edge: the quantile estimator answers at
+    # most that edge, so an objective at/above it could never be exceeded
+    # and the shipped SLO rule would be dead on arrival.
+    burn = next(r for r in base if r.name == "serve_p99_burn")
+    assert burn.objective_s < max(metrics.family_buckets(burn.metric))
+    # Inline JSON overlays and same-name entries REPLACE defaults.
+    inline = json.dumps([{"name": "worker_stalled", "kind": "threshold",
+                          "metric": "ps.worker.last_seen_s.*", "op": ">",
+                          "value": 33.0},
+                         {"name": "extra", "kind": "threshold",
+                          "metric": "mp.x", "op": "<", "value": 1.0}])
+    rules = {r.name: r for r in alerts.load_rules(inline)}
+    assert rules["worker_stalled"].value == 33.0
+    assert "extra" in rules and len(rules) == len(base) + 1
+    # A file path loads the same way; {"defaults": false} drops the ship set.
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({"defaults": False, "rules": [
+        {"name": "only", "kind": "threshold", "metric": "mp.y",
+         "op": ">", "value": 0.0}]}))
+    only = alerts.load_rules(str(p))
+    assert [r.name for r in only] == ["only"]
+    # Malformed entries degrade: the bad rule is SKIPPED with a warning, the
+    # good ones load, nothing raises (the loop-never-crashes contract).
+    mixed = json.dumps([{"name": "bad", "kind": "nonsense", "metric": "m"},
+                        {"name": "good", "kind": "threshold", "metric": "m",
+                         "op": ">", "value": 1.0},
+                        {"name": "worse", "kind": "threshold", "metric": "m",
+                         "op": ">", "value": 1.0, "typo_field": 3}])
+    loaded = {r.name for r in alerts.load_rules(mixed)}
+    assert "good" in loaded and "bad" not in loaded and "worse" not in loaded
+    # An unreadable source keeps the shipped defaults.
+    fallback = alerts.load_rules(str(tmp_path / "missing.json"))
+    assert {r.name for r in fallback} == {r.name for r in base}
+
+
+def test_bad_rule_evaluation_never_crashes_sampling():
+    class _Boom(alerts.AlertRule):
+        def evaluate(self, history):
+            raise RuntimeError("boom")
+    eng = alerts.AlertEngine(rules=[
+        _Boom(name="boom", kind="threshold", metric="m", op=">", value=0.0),
+        alerts.AlertRule(name="ok", kind="threshold", metric="mp.ok.gauge",
+                         op=">", value=1.0)], action="warn")
+    telemetry.gauge("mp.ok.gauge").set(5.0)
+    h = _mk_history(engine=eng)
+    rec = h.sample()                      # engine runs inside sample()
+    assert [f["rule"] for f in eng.active()] == ["ok"]
+    assert rec is not None                # the sampling loop survived boom
+
+
+def test_alert_action_halt_raises_from_sample():
+    telemetry.gauge("mp.halt.gauge").set(9.0)
+    eng = alerts.AlertEngine(rules=[alerts.AlertRule(
+        name="h", kind="threshold", metric="mp.halt.gauge", op=">",
+        value=1.0)], action="halt")
+    h = _mk_history(engine=eng)
+    with pytest.raises(alerts.AlertHalt, match="h"):
+        h.sample()
+    # Everything was booked BEFORE the raise: gauge, event, active record.
+    assert telemetry.gauge("alert.active.h").value == 1
+    assert [e["name"] for e in telemetry.events()] == ["alert"]
+    assert [a["rule"] for a in eng.active()] == ["h"]
+    with pytest.raises(ValueError, match="action"):
+        alerts.AlertEngine(rules=[], action="explode")
+
+
+def test_alert_halt_from_train_loop_carries_live_state():
+    """action=halt raised at a train() boundary rides with the LIVE
+    TrainState attached (the HealthHalt contract: progress stays
+    checkpointable, not discarded)."""
+    eng = alerts.AlertEngine(rules=[alerts.AlertRule(
+        name="rate_floor", kind="threshold", metric="train.steps_per_s",
+        op=">", value=0.0)], action="halt")
+    history.set_history(history.MetricsHistory(
+        out_dir="", min_interval_s=0.0, engine=eng))
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(4, 1).astype(np.float32)}
+
+    def loss(p, b):
+        return jnp.mean((b["y"] - b["x"] @ p["w"]) ** 2)
+
+    def batches(i):
+        return {"x": rng.randn(8, 4).astype(np.float32),
+                "y": rng.randn(8, 1).astype(np.float32)}
+
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(loss, params, optax.sgd(0.01),
+                                           example_batch=batches(0))
+    with pytest.raises(alerts.AlertHalt) as exc:
+        train(runner, params, batches, steps=8, log_every=2)
+    assert exc.value.state is not None
+    assert int(exc.value.state.step) > 0          # the live TrainState
+    assert exc.value.fired[0]["rule"] == "rate_floor"
+
+
+def test_alert_record_action_snapshots_through_debounce(tmp_path):
+    telemetry.gauge("mp.rec.gauge").set(9.0)
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=4,
+                                  min_interval_s=3600.0)
+    eng = alerts.AlertEngine(rules=[alerts.AlertRule(
+        name="r1", kind="threshold", metric="mp.rec.gauge", op=">",
+        value=1.0)], action="record", recorder=rec)
+    alerts.set_engine(eng)    # the manifest reads the PROCESS engine
+    h = _mk_history(engine=eng)
+    h.sample()
+    snaps = rec.snapshots()
+    assert len(snaps) == 1 and "alert.r1" in snaps[0]
+    manifest = json.load(open(os.path.join(snaps[0], "manifest.json")))
+    assert [a["rule"] for a in manifest["alerts"]] == ["r1"]
+    # Re-firing inside the debounce window writes NO second snapshot (the
+    # through-the-debounce contract — an alert storm costs one capture).
+    telemetry.gauge("mp.rec.gauge").set(0.0)
+    h.sample()                                     # resolve
+    telemetry.gauge("mp.rec.gauge").set(9.0)
+    h.sample()                                     # re-fire
+    assert len(rec.snapshots()) == 1
+
+
+# ------------------------------------------------------- e2e acceptance pin
+
+def test_injected_data_stall_fires_drift_alert_end_to_end(tmp_path):
+    """The PR's no-human-in-the-loop proof: a stalling data loader inside a
+    REAL train() drifts train.attr.data_wait past the SHIPPED rule's band ->
+    the alert event fires at a history boundary -> the flight recorder
+    snapshots with the alert in its manifest -> the live /metrics endpoint
+    exposes the alert gauge. Nothing here pokes the engine by hand."""
+    profiling.enable()
+    profiling.reset()
+    # The applied plan whose predicted bound the SHIPPED drift rule compares
+    # against (data_wait predicted share: 0 — any stall is drift).
+    profiling.set_applied_plan({
+        "cache_key": "e2e", "knobs": {"unroll": 1},
+        "predicted": {"step_s": 0.004, "bound": "compute",
+                      "breakdown": {"compute_s": 0.004}}})
+    rec = recorder.FlightRecorder(str(tmp_path / "fr"), keep=4,
+                                  min_interval_s=0.0)
+    recorder.set_recorder(rec)
+    eng = alerts.AlertEngine(rules=alerts.load_rules(""), action="warn")
+    alerts.set_engine(eng)
+    history.set_history(history.MetricsHistory(
+        out_dir=str(tmp_path / "hist"), min_interval_s=0.0, engine=eng))
+    exporter = openmetrics.MetricsExporter(port=0)
+    openmetrics.set_exporter(exporter)
+    try:
+        rng = np.random.RandomState(0)
+        params = {"w": rng.randn(4, 1).astype(np.float32)}
+
+        def loss(p, b):
+            return jnp.mean((b["y"] - b["x"] @ p["w"]) ** 2)
+
+        def batches(i):
+            time.sleep(0.012)     # the injected loader stall (~dominant)
+            return {"x": rng.randn(8, 4).astype(np.float32),
+                    "y": rng.randn(8, 1).astype(np.float32)}
+
+        ad = AutoDist(strategy_builder=AllReduce())
+        runner = ad.create_distributed_session(loss, params, optax.sgd(0.01),
+                                               example_batch=batches(0))
+        train(runner, params, batches, steps=12, log_every=4)
+
+        # 1. the shipped drift rule fired as an `alert` event.
+        fired = [e for e in telemetry.events() if e["name"] == "alert"
+                 and e.get("rule") == "data_wait_drift"
+                 and e.get("state") == "firing"]
+        assert fired, f"no data_wait_drift firing in {telemetry.events()}"
+        assert fired[0]["value"] > fired[0]["bound"] + fired[0]["band"]
+        # 2. the flight recorder snapshotted WITH the alert in its manifest.
+        # Other shipped rules may legitimately fire first off gauges earlier
+        # suites left in the shared registry (e.g. worker_stalled from a
+        # watchdog test's last-seen gauge) — find the drift snapshot, don't
+        # assume it won the race for slot 0.
+        snaps = [s for s in rec.snapshots() if "alert.data_wait_drift" in s]
+        assert snaps, f"no data_wait_drift snapshot in {rec.snapshots()}"
+        manifest = json.load(open(os.path.join(snaps[0], "manifest.json")))
+        assert any(a["rule"] == "data_wait_drift"
+                   for a in manifest["alerts"])
+        assert manifest["plan"]["cache_key"] == "e2e"
+        # 3. the same process's /metrics exposition carries the alert plane:
+        # the per-rule active gauge and the fired counter. The counter is
+        # the race-free proof — the end-of-run forced sample re-evaluates
+        # the rules on the TAIL period, whose share can legitimately dip
+        # back inside the band and auto-resolve the gauge to 0 before this
+        # scrape (observed under full-suite load), and an auto-resolve is
+        # correct behavior, not a missed alert.
+        port = exporter.address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        types, samples = _parse_exposition(body)
+        assert ("alert_active_data_wait_drift", frozenset()) in samples
+        assert types["alert_active_data_wait_drift"] == "gauge"
+        assert samples[("alert_fired_total", frozenset())] >= 1
+        assert types["train_attr_data_wait"] == "gauge"
+        # 4. the history's JSONL shards retain the drifted series on disk.
+        h = history.get_history()
+        vals = [v for _, v in h.series("train.attr.data_wait")]
+        assert vals and max(vals) > 0.25
+        assert h.shards()
+    finally:
+        profiling.disable()
+        profiling.reset()
+
+
+# --------------------------------------------------- /metrics + /healthz HTTP
+
+def test_metrics_and_healthz_endpoints_over_loopback():
+    telemetry.counter("mp.http.requests").inc(7)
+    exp = openmetrics.MetricsExporter(port=0)
+    try:
+        port = exp.address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10)
+        assert body.headers["Content-Type"].startswith("text/plain")
+        types, samples = _parse_exposition(body.read().decode())
+        assert samples[("mp_http_requests_total", frozenset())] >= 7
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert hz["ok"] is True and hz["uptime_s"] >= 0
+        assert hz["alerts_active"] == 0
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+    finally:
+        exp.close()
+
+
+def test_maybe_serve_env_gating(monkeypatch):
+    monkeypatch.delenv("AUTODIST_METRICS_PORT", raising=False)
+    assert openmetrics.maybe_serve() is None
+    monkeypatch.setenv("AUTODIST_METRICS_PORT", "0")
+    assert openmetrics.maybe_serve() is None       # "0" stays disabled
+    exp = openmetrics.MetricsExporter(port=0)
+    openmetrics.set_exporter(exp)
+    monkeypatch.setenv("AUTODIST_METRICS_PORT", str(exp.address[1]))
+    assert openmetrics.maybe_serve() is exp        # one exporter per process
+
+
+# ------------------------------------------------------------ fleet console
+
+class _StubPSRunner:
+    """The minimal surface PSServer._dispatch drives (the test_health_plane
+    pattern): a real gate + numpy-only ParameterService, no compilation."""
+
+    def __init__(self, num_workers=1, staleness=2):
+        from autodist_tpu.parallel.staleness import (ParameterService,
+                                                     StalenessController)
+        from autodist_tpu.runner import TrainState
+        state = TrainState(step=np.zeros((), np.int32),
+                           params={"w": np.ones((16,), np.float32)},
+                           opt_state=(), ef_state=())
+        self.service = ParameterService(state, lambda s, grads: s)
+        self.controller = StalenessController(num_workers,
+                                              staleness=staleness)
+
+    def add_worker(self, worker_id=None, with_generation=False):
+        wid, gen = self.controller.register_with_generation(worker_id)
+        handle = type("H", (), {"worker_id": wid})()
+        return (handle, gen) if with_generation else handle
+
+
+class _FakeServeEngine:
+    capacity = 2
+
+    def admit(self, slot, prompt, key):
+        return 0
+
+    def step(self, keys):
+        return np.zeros((self.capacity,), np.int32)
+
+    def free(self, slot):
+        pass
+
+    def make_keys(self, seed, n):
+        return None
+
+
+def _two_servers():
+    from autodist_tpu.parallel.ps_transport import PSServer
+    from autodist_tpu.serving.batcher import Batcher, ServeConfig
+    from autodist_tpu.serving.transport import InferenceServer
+    ps = PSServer(_StubPSRunner(), host="127.0.0.1", watchdog=False)
+    batcher = Batcher(_FakeServeEngine(), ServeConfig(max_batch=2),
+                      start=False)
+    serve = InferenceServer(batcher, host="127.0.0.1", port=0)
+    return ps, serve
+
+
+def _adfleet():
+    spec = importlib.util.spec_from_file_location(
+        "adfleet_cli", os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools", "adfleet.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_adfleet_once_and_raw_against_two_loopback_servers(capsys):
+    telemetry.gauge("train.steps_per_s").set(41.5)
+    telemetry.gauge("train.mfu").set(0.283)
+    lat = telemetry.histogram("serve.latency_s.total")
+    for v in (0.002, 0.004, 0.2):
+        lat.observe(v)
+    telemetry.gauge("mp.fleet.alert_src").set(9.0)
+    eng = alerts.AlertEngine(rules=[alerts.AlertRule(
+        name="fleet_rule", kind="threshold", metric="mp.fleet.alert_src",
+        op=">", value=1.0)], action="warn")
+    alerts.set_engine(eng)
+    _mk_history(engine=eng).sample()      # one tick: the rule fires
+    ps, serve = _two_servers()
+    try:
+        ps_addr = "%s:%d" % ps.address
+        serve_addr = "%s:%d" % serve.address
+        fl = _adfleet()
+        assert fl.main([ps_addr, serve_addr, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "adfleet — 2 endpoint(s)" in out
+        assert "ps" in out and "serve" in out
+        assert "steps/s   41.50" in out
+        assert "mfu  28.3%" in out
+        # Fleet aggregation: both endpoints ship the process registry's
+        # latency histogram; the merged quantile line renders.
+        assert "fleet    serve n=2" in out
+        assert "p99" in out
+        # The union of active alerts names the rule and the endpoint.
+        assert "fleet_rule" in out and "ALERT" in out
+        # --raw ships the JSON payload per endpoint.
+        assert fl.main(["--endpoints", f"{ps_addr},{serve_addr}",
+                        "--raw"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert {doc[ps_addr]["kind"], doc[serve_addr]["kind"]} \
+            == {"ps", "serve"}
+        assert doc[ps_addr]["alerts"]["active"][0]["rule"] == "fleet_rule"
+    finally:
+        serve.close()
+        ps.close()
+
+
+def test_ps_server_arms_wall_clock_history(tmp_path, monkeypatch):
+    """A PS chief may have NO train boundary or scheduler round — the
+    server constructor must arm the history so the wall-clock thread
+    becomes its sampling beat (else worker_stalled never evaluates in the
+    very process booking the last-seen gauges)."""
+    monkeypatch.setenv("AUTODIST_METRICS_DIR", str(tmp_path / "hist"))
+    monkeypatch.setenv("AUTODIST_METRICS_INTERVAL_S", "0.1")
+    history.set_history(None)          # reset the env-arming cache
+    from autodist_tpu.parallel.ps_transport import PSServer
+    server = PSServer(_StubPSRunner(), host="127.0.0.1", watchdog=False)
+    try:
+        h = history.get_history()
+        assert h is not None           # armed by the constructor
+        deadline = time.monotonic() + 5.0
+        while not h.samples() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert h.samples(), "wall-clock beat produced no sample in 5s"
+        assert h.samples()[0]["reason"] == "timer"
+        assert h.shards()              # and the series reached disk
+    finally:
+        server.close()
+
+
+def test_adfleet_survives_dead_endpoint(capsys, monkeypatch):
+    ps, serve = _two_servers()
+    serve_addr = "%s:%d" % serve.address
+    try:
+        fl = _adfleet()
+        # One live + one dead endpoint: renders, exits 0 (partial fleet).
+        assert fl.main([serve_addr, "127.0.0.1:1", "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "DOWN" in out and "serve" in out
+        # Every endpoint dead: exit 1 (scripts gate on it).
+        assert fl.main(["127.0.0.1:1", "--once"]) == 1
+        capsys.readouterr()
+        # No endpoints at all (and no env fallback): usage error, exit 2.
+        monkeypatch.delenv("AUTODIST_PS_ADDR", raising=False)
+        monkeypatch.delenv("AUTODIST_SERVE_ADDR", raising=False)
+        assert fl.main(["--once"]) == 2
+    finally:
+        serve.close()
+        ps.close()
+
+
+# ----------------------------------------------------------- flag registry
+
+def test_new_flags_registered_and_typed(monkeypatch):
+    for flag in ("AUTODIST_METRICS_DIR", "AUTODIST_METRICS_PORT",
+                 "AUTODIST_METRICS_INTERVAL_S", "AUTODIST_ALERT_RULES",
+                 "AUTODIST_ALERT_ACTION"):
+        assert flag in const.KNOWN_FLAGS
+        assert hasattr(const.ENV, flag)
+    assert const.ENV.AUTODIST_METRICS_DIR.val == ""
+    assert const.ENV.AUTODIST_METRICS_PORT.val == ""
+    assert const.ENV.AUTODIST_METRICS_INTERVAL_S.val == 0.0
+    assert const.ENV.AUTODIST_ALERT_ACTION.val == "warn"
+    monkeypatch.setenv("AUTODIST_METRICS_INTERVAL_S", "2.5")
+    assert const.ENV.AUTODIST_METRICS_INTERVAL_S.val == 2.5
+    monkeypatch.setenv("AUTODIST_ALERT_ACTION", "halt")
+    eng = alerts.AlertEngine(rules=[])
+    assert eng.action == "halt"

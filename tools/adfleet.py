@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""adfleet — a multi-endpoint fleet console for autodist servers.
+
+Where ``adtop`` watches ONE process, adfleet polls the ``status`` wire opcode
+across N addresses concurrently and renders a merged screen: one row per
+process (role, uptime, step rate, MFU, staleness bound/worst lag, serving
+queue/slots, SLO p50/p99, active alerts), then FLEET-AGGREGATED serving
+quantiles (latency histograms merged element-wise before the quantile — the
+mathematically right aggregation; averaging per-replica p99s is not), the
+union of active alerts, and the newest events across the fleet. This is the
+signal surface ROADMAP 2's replica router reads: which replica to drain, who
+is burning SLO budget, whether an alert names a culprit.
+
+Usage:
+    python tools/adfleet.py HOST:PORT HOST:PORT ...   # live screen, 2s poll
+    python tools/adfleet.py A:1 B:2 --once            # one plain-text pass
+    python tools/adfleet.py A:1 B:2 --raw             # one JSON pass
+    python tools/adfleet.py --endpoints A:1,B:2 --interval 5
+
+With no addresses, ``AUTODIST_PS_ADDR`` and ``AUTODIST_SERVE_ADDR`` seed the
+list. A dead endpoint renders as an error row — the fleet view must survive
+any one replica being the incident.
+"""
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+# The single-endpoint console's formatters, reused so the two consoles
+# cannot drift on how an age or an alert line reads.
+from adtop import _alert_line, _fmt_age  # noqa: E402
+
+
+def fetch_fleet(addresses, timeout: float = 2.0) -> dict:
+    """``{address: status-payload-or-{"error": ...}}`` polled CONCURRENTLY —
+    a fleet poll must take one slowest-endpoint round-trip, not the sum.
+
+    ``timeout`` is deliberately SHORT (the PS client retries a refused
+    connect until this deadline — right for a worker waiting on its chief,
+    wrong for a liveness poll): a crashed replica must read as DOWN in a
+    couple of seconds, not stall every screen refresh for the worker-grade
+    10s."""
+    from autodist_tpu.parallel.ps_transport import _PSClient
+
+    def one(address):
+        # read_timeout too: a hung-but-accepting server must read as DOWN,
+        # not park the poll thread on a reply that never comes.
+        client = _PSClient(address, connect_timeout=timeout,
+                           read_timeout=timeout)
+        try:
+            return client.call("status")[0]
+        finally:
+            client.close()
+
+    out = {}
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, max(1, len(addresses)))) as pool:
+        futs = {pool.submit(one, a): a for a in addresses}
+        for fut in concurrent.futures.as_completed(futs):
+            addr = futs[fut]
+            try:
+                out[addr] = fut.result()
+            except Exception as e:
+                out[addr] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _fmt_q(value) -> str:
+    return f"{value * 1e3:.0f}ms" if value is not None else "-"
+
+
+def _row(address: str, status: dict) -> str:
+    from autodist_tpu.telemetry import metrics as _metrics
+    if status.get("error") and "kind" not in status:
+        return f"  {address:<22} DOWN   {status['error']}"
+    kind = status.get("kind", "?")
+    reg = status.get("registry", {}) or {}
+    cols = [f"  {address:<22} {kind:<6}",
+            f"up {_fmt_age(status.get('uptime_s', 0)):>6}"]
+    rate = reg.get("train.steps_per_s")
+    cols.append(f"steps/s {rate:7.2f}" if isinstance(rate, (int, float))
+                else "steps/s       -")
+    mfu = reg.get("train.mfu")
+    cols.append(f"mfu {100.0 * mfu:5.1f}%" if isinstance(mfu, (int, float))
+                else "mfu      -")
+    if kind == "ps":
+        lags = [w.get("lag") for w in (status.get("per_worker") or {}).values()
+                if isinstance(w.get("lag"), (int, float))]
+        bound = status.get("staleness_bound")
+        cols.append(f"lag {max(lags) if lags else 0}/"
+                    f"{bound if bound is not None else 'inf'}")
+    elif kind == "serve":
+        cap = status.get("capacity", 0)
+        busy = len(status.get("in_flight") or [])
+        cols.append(f"q {status.get('queue_depth', 0)} "
+                    f"slots {busy}/{cap}")
+        total = reg.get("serve.latency_s.total")
+        if isinstance(total, dict):
+            cols.append(f"p50 {_fmt_q(_metrics.quantile(total, 0.5))} "
+                        f"p99 {_fmt_q(_metrics.quantile(total, 0.99))}")
+    active = (status.get("alerts") or {}).get("active") or []
+    if active:
+        cols.append("ALERT " + ",".join(sorted(a.get("rule", "?")
+                                               for a in active)))
+    return "  ".join(cols)
+
+
+def render(fleet: dict) -> str:
+    """One plain-text screen for a fleet poll — the single rendering path
+    behind ``--once`` and the live loop (the adtop contract: tests pin
+    exactly what operators see)."""
+    from autodist_tpu.telemetry import metrics as _metrics
+    lines = [f"adfleet — {len(fleet)} endpoint(s)  "
+             f"{time.strftime('%H:%M:%S')}"]
+    lines.append("  endpoint               role   uptime    throughput ...")
+    for addr in sorted(fleet):
+        lines.append(_row(addr, fleet[addr]))
+
+    # Fleet-aggregated serving quantiles: merge the latency histograms
+    # element-wise across replicas, THEN take the quantile (the only
+    # aggregation that answers "what latency does a fleet user see").
+    hists = [(s.get("registry") or {}).get("serve.latency_s.total")
+             for s in fleet.values() if isinstance(s, dict)]
+    hists = [h for h in hists if isinstance(h, dict)]
+    if hists:
+        merged = _metrics.merge_histograms(hists)
+        count = merged.get("count", 0)
+        lines.append(
+            f"fleet    serve n={len(hists)}  requests {count}  "
+            f"p50 {_fmt_q(_metrics.quantile(merged, 0.5))}  "
+            f"p99 {_fmt_q(_metrics.quantile(merged, 0.99))}")
+
+    # The union of active alerts, who is firing them, and the newest events.
+    firing = []
+    for addr in sorted(fleet):
+        for a in ((fleet[addr].get("alerts") or {}).get("active") or []):
+            firing.append((addr, a))
+    if firing:
+        lines.append(f"alerts   {len(firing)} active")
+        for addr, a in firing:
+            # adtop's shared alert-line formatter with the endpoint spliced
+            # in — two consoles, one rendering of an alert record.
+            lines.append(_alert_line(a, where=f" @ {addr}"))
+    events = []
+    for addr, s in fleet.items():
+        for rec in (s.get("events") or [])[-3:]:
+            if isinstance(rec, dict):
+                events.append((rec.get("t_wall_s") or 0, addr, rec))
+    # Sort on (time, endpoint) ONLY: two same-millisecond events would
+    # otherwise fall through to comparing the record dicts and raise.
+    for t_wall, addr, rec in sorted(events, key=lambda e: e[:2])[-5:]:
+        when = time.strftime("%H:%M:%S", time.localtime(t_wall)) \
+            if t_wall else "--:--:--"
+        lines.append(f"  {when}  {rec.get('name', 'event')} @ {addr}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="adfleet", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("addresses", nargs="*", default=[],
+                    help="server host:port endpoints (default: "
+                         "AUTODIST_PS_ADDR + AUTODIST_SERVE_ADDR)")
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port list (merged with "
+                         "positional addresses)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one merged snapshot and exit")
+    ap.add_argument("--raw", action="store_true",
+                    help="print one raw JSON fleet payload and exit")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for the live screen (default 2)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-endpoint connect/read deadline seconds "
+                         "(default 2 — a dead replica reads DOWN fast)")
+    args = ap.parse_args(argv)
+    addresses = list(args.addresses)
+    addresses += [a for a in args.endpoints.split(",") if a]
+    if not addresses:
+        from autodist_tpu import const
+        addresses = [a for a in (str(const.ENV.AUTODIST_PS_ADDR.val),
+                                 str(const.ENV.AUTODIST_SERVE_ADDR.val)) if a]
+    if not addresses:
+        print("adfleet: no endpoints given and neither AUTODIST_PS_ADDR nor "
+              "AUTODIST_SERVE_ADDR is set", file=sys.stderr)
+        return 2
+    fleet = fetch_fleet(addresses, timeout=args.timeout)
+    if args.raw:
+        print(json.dumps(fleet, default=str, indent=1))
+        return 0
+    if args.once:
+        print(render(fleet))
+        # Every endpoint down is an exit-code failure (scripts gate on it);
+        # a PARTIALLY-down fleet still renders and exits 0.
+        all_down = all(isinstance(s, dict) and s.get("error")
+                       and "kind" not in s for s in fleet.values())
+        return 1 if all_down else 0
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H" + render(fleet) + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+            fleet = fetch_fleet(addresses, timeout=args.timeout)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

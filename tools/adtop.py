@@ -37,9 +37,12 @@ if ROOT not in sys.path:
 def fetch_status(address, timeout: float = 10.0) -> dict:
     """One ``status`` request against ``address`` (``host:port`` or a
     ``(host, port)`` tuple); raises ConnectionError/PSClientError on an
-    unreachable or pre-``status`` server."""
+    unreachable or pre-``status`` server. ``timeout`` bounds the reply wait
+    too — a hung-but-accepting server must error a console poll, not park
+    it forever."""
     from autodist_tpu.parallel.ps_transport import _PSClient
-    client = _PSClient(address, connect_timeout=timeout)
+    client = _PSClient(address, connect_timeout=timeout,
+                       read_timeout=timeout)
     try:
         return client.call("status")[0]
     finally:
@@ -56,24 +59,12 @@ def _fmt_age(seconds) -> str:
 
 
 def _hist_quantile(hist: dict, q: float):
-    """Approximate quantile from a histogram snapshot dict (``le:<bound>``
-    keys + ``count``): the upper bound of the first bucket whose cumulative
-    count reaches ``q``. None for an empty histogram."""
-    total = hist.get("count", 0)
-    if not total:
-        return None
-    edges = []
-    for key, n in hist.items():
-        if key.startswith("le:") and key != "le:+inf":
-            edges.append((float(key[3:]), n))
-    edges.sort()
-    target = q * total
-    seen = 0
-    for bound, n in edges:
-        seen += n
-        if seen >= target:
-            return bound
-    return float("inf")
+    """The SHARED bucket-interpolating estimator
+    (:func:`autodist_tpu.telemetry.metrics.quantile`) — the alert engine's
+    burn-rate predicate and adfleet's aggregation use the same one, so no
+    two consoles can disagree on what p99 means."""
+    from autodist_tpu.telemetry import metrics as _metrics
+    return _metrics.quantile(hist, q)
 
 
 def _counter(reg: dict, name: str):
@@ -121,6 +112,36 @@ def _event_lines(events, limit: int = 5) -> list:
             if t_wall else "--:--:--"
         fields = " ".join(f"{k}={v}" for k, v in sorted(rec.items()))
         out.append(f"  {when}  {name}  {fields}")
+    return out
+
+
+def _alert_detail(a: dict) -> str:
+    """The numbers that tripped one active-alert record, as ``k=v`` pairs —
+    ONE formatter shared with ``tools/adfleet.py`` (like the quantile
+    helper: two consoles must read an alert record identically)."""
+    return " ".join(f"{k}={v}" for k, v in sorted(a.items())
+                    if k not in ("rule", "fired_t_wall_s", "for_s"))
+
+
+def _alert_line(a: dict, where: str = "") -> str:
+    """One active alert as one console line (``where`` splices a fleet
+    endpoint in) — the layout itself is shared, not just the detail."""
+    return (f"  {a.get('rule', '?'):<18} firing "
+            f"{_fmt_age(a.get('for_s', 0))}{where}  {_alert_detail(a)}")
+
+
+def _alert_lines(alerts: dict) -> list:
+    """The status payload's ``alerts`` section: one line per ACTIVE firing
+    (rule, how long, the numbers that tripped it), plus a recently-resolved
+    count. Nothing when the alert plane never armed (rules == 0)."""
+    active = alerts.get("active") or []
+    resolved = alerts.get("resolved") or []
+    if not active and not resolved:
+        return []
+    out = [f"alerts   {len(active)} active, {len(resolved)} recently "
+           f"resolved (action {alerts.get('action') or '?'})"]
+    for a in active:
+        out.append(_alert_line(a))
     return out
 
 
@@ -187,8 +208,8 @@ def render(status: dict, address: str = "") -> str:
             p99 = _hist_quantile(total, 0.99)
             lines.append(
                 f"slo      done {done or 0}  rejected {rej or 0}  "
-                f"p50<= {p50 if p50 is not None else '-'}s  "
-                f"p99<= {p99 if p99 is not None else '-'}s")
+                f"p50~ {f'{p50:.4g}' if p50 is not None else '-'}s  "
+                f"p99~ {f'{p99:.4g}' if p99 is not None else '-'}s")
         if in_flight:
             lines.append("request  slot   age  tokens  prompt")
             for r in in_flight:
@@ -199,6 +220,7 @@ def render(status: dict, address: str = "") -> str:
                              f"{r.get('prompt_len', 0):>6}")
     lines.extend(_perf_lines(reg))
     lines.extend(_health_lines(reg))
+    lines.extend(_alert_lines(status.get("alerts") or {}))
     events = status.get("events") or status.get("anomalies") or []
     if events:
         lines.append(f"events   ({len(events)} recorded, newest last)")
