@@ -117,6 +117,13 @@ KNOWN_FLAGS = {
                           "stage-1 survivors are measured with real steps",
     "AUTODIST_TUNE_BUDGET": "autotuner stage-1 budget: cap on enumerated "
                             "candidates ranked by the calibrated cost model",
+    "AUTODIST_PREFETCH_DEPTH": "train() input-pipeline prefetch depth: a "
+                               "background producer pulls + shards this "
+                               "many batches (blocks under unroll=K) ahead "
+                               "of the step; 0 = synchronous feed",
+    "AUTODIST_PREFETCH_WORKERS": "prefetch producer worker threads: source "
+                                 "pulls stay serialized/ordered, the "
+                                 "shard/stack transform parallelizes",
     "AUTODIST_METRICS_DIR": "metric-history shard directory: each registry "
                             "sample appends one JSONL line (rotation-capped "
                             "shards); also arms boundary sampling",
@@ -254,6 +261,14 @@ _ENV_DEFAULTS = {
     "AUTODIST_PLAN_CACHE": "",
     "AUTODIST_TUNE_TOPK": 3,
     "AUTODIST_TUNE_BUDGET": 32,
+    # Input-data plane (autodist_tpu/data/prefetch.py): async sharded
+    # prefetch behind train()/device_prefetch. DEPTH is the bounded queue
+    # of batches (blocks under unroll=K) the background producer keeps
+    # pre-sharded ahead of the step (0 = the synchronous feed, the
+    # previous behavior); WORKERS parallelizes the shard/stack transform
+    # stage (loader pulls always stay serialized and ordered).
+    "AUTODIST_PREFETCH_DEPTH": 0,
+    "AUTODIST_PREFETCH_WORKERS": 1,
     # Fleet metrics plane (autodist_tpu/telemetry/{history,openmetrics,
     # alerts}.py): on-disk metric history, the Prometheus-format scrape
     # endpoint, and declarative SLO/drift alert rules evaluated on every
@@ -313,6 +328,8 @@ class ENV(enum.Enum):
     AUTODIST_PLAN_CACHE = "AUTODIST_PLAN_CACHE"
     AUTODIST_TUNE_TOPK = "AUTODIST_TUNE_TOPK"
     AUTODIST_TUNE_BUDGET = "AUTODIST_TUNE_BUDGET"
+    AUTODIST_PREFETCH_DEPTH = "AUTODIST_PREFETCH_DEPTH"
+    AUTODIST_PREFETCH_WORKERS = "AUTODIST_PREFETCH_WORKERS"
     AUTODIST_METRICS_DIR = "AUTODIST_METRICS_DIR"
     AUTODIST_METRICS_PORT = "AUTODIST_METRICS_PORT"
     AUTODIST_METRICS_INTERVAL_S = "AUTODIST_METRICS_INTERVAL_S"

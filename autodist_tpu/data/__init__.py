@@ -1,9 +1,15 @@
-"""Host data pipeline (native prefetch loader + device prefetch + datasets)."""
+"""Host data pipeline (native prefetch loader + async device prefetch +
+per-host sharded loading + datasets)."""
 
-from autodist_tpu.data import imagenet, mlm, movielens, text_corpus
+from autodist_tpu.data import imagenet, mlm, movielens, prefetch, text_corpus
 from autodist_tpu.data.loader import (DataLoader, device_prefetch,
                                       save_shards, shard_files_for_process)
+from autodist_tpu.data.prefetch import (BoundedQueue, PrefetchProducer,
+                                        assemble_global_batch, host_shard,
+                                        host_shard_rows, prefetch_to_device)
 
 __all__ = ["DataLoader", "device_prefetch", "save_shards",
            "shard_files_for_process", "imagenet", "mlm", "movielens",
-           "text_corpus"]
+           "text_corpus", "prefetch", "BoundedQueue", "PrefetchProducer",
+           "prefetch_to_device", "host_shard", "host_shard_rows",
+           "assemble_global_batch"]

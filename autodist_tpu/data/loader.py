@@ -20,16 +20,19 @@ in-memory arrays OR from ``.npy`` shard files on disk:
 - **Fallback path**: the same semantics in numpy (used when no C++ toolchain is
   available, and as the reference implementation in tests).
 
-``device_prefetch`` composes either path with the runner's feed remapping: it
-keeps ``prefetch`` batches in flight on-device (``shard_batch`` = device_put
-with the batch sharding) so host->HBM transfer also overlaps the step.
-``save_shards`` writes a dict of arrays as row-aligned ``.npy`` shard files
-(the writer side of the ``files=`` contract).
+``device_prefetch`` composes either path with the runner's feed remapping
+through the unified async input pipeline (:mod:`autodist_tpu.data.prefetch`):
+a bounded background producer keeps ``depth`` pre-sharded batches in flight
+on-device (``shard_batch`` = device_put with the batch sharding) so host
+loading AND host->HBM transfer overlap the step. ``save_shards`` writes a
+dict of arrays as row-aligned ``.npy`` shard files (the writer side of the
+``files=`` contract).
 """
 
 import ctypes
 import os
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -218,6 +221,15 @@ class DataLoader:
         self._lib = _build_native() if native in (None, True) else None
         if native is True and self._lib is None:
             raise RuntimeError("native=True but the native loader failed to build")
+        # Async prefetch (data/prefetch.py) pulls next() from a background
+        # producer thread, so close() can race an in-flight native dl_next —
+        # dl_destroy frees the C++ loader and a parked waiter would wake on
+        # freed memory. The condition tracks in-flight native calls: close()
+        # flips `_closing` (new next() calls fail fast) and waits (bounded)
+        # for the in-flight count to drain before destroying.
+        self._native_cv = threading.Condition()
+        self._native_inflight = 0
+        self._closing = False
         self._handle = None
         if self._lib is not None:
             self._handle = self._create_native()
@@ -267,13 +279,36 @@ class DataLoader:
         return self._epochs
 
     def next(self) -> Dict[str, np.ndarray]:
-        """The next batch (blocks on the prefetch ring in the native path)."""
+        """The next batch (blocks on the prefetch ring in the native path).
+
+        Thread-safe against :meth:`close`: a concurrent close waits for
+        in-flight native calls to return before destroying the C++ loader,
+        and calls arriving DURING OR AFTER the close raise cleanly (a
+        closed native loader must not fall into the numpy-fallback branch,
+        whose state was never initialized)."""
+        if self._closing:
+            raise RuntimeError("Native loader was shut down")
         out = {k: np.empty((self.batch_size,) + self._row_shape(k),
                            self._dtype(k)) for k in self._keys}
-        if self._handle is not None:
-            ptrs = (ctypes.c_void_p * len(self._keys))(
-                *[out[k].ctypes.data for k in self._keys])
-            if self._lib.dl_next(self._handle, ptrs) != 0:
+        # Branch on _lib (immutable), NOT _handle: a close() completing
+        # between the check above and here nulls _handle, and a native-mode
+        # call must then raise below — never fall into the numpy fallback,
+        # whose state native mode leaves uninitialized.
+        if self._lib is not None:
+            with self._native_cv:
+                if self._closing or self._handle is None:
+                    raise RuntimeError("Native loader was shut down")
+                handle = self._handle
+                self._native_inflight += 1
+            try:
+                ptrs = (ctypes.c_void_p * len(self._keys))(
+                    *[out[k].ctypes.data for k in self._keys])
+                rc = self._lib.dl_next(handle, ptrs)
+            finally:
+                with self._native_cv:
+                    self._native_inflight -= 1
+                    self._native_cv.notify_all()
+            if rc != 0:
                 raise RuntimeError("Native loader was shut down")
             return out
         # numpy fallback: same drop-last/reshuffle-on-wrap semantics.
@@ -298,10 +333,31 @@ class DataLoader:
         while True:
             yield self.next()
 
-    def close(self):
-        if self._handle is not None:
-            self._lib.dl_destroy(self._handle)
-            self._handle = None
+    def close(self, timeout_s: float = 60.0):
+        """Shut the native loader down. Safe against a concurrent
+        :meth:`next` from a prefetch producer thread: new calls fail fast,
+        in-flight ones are drained (bounded wait — one call returns within
+        one batch-gather) before ``dl_destroy`` frees the C++ state. A
+        drain that somehow exceeds ``timeout_s`` leaks the handle with a
+        warning instead of freeing memory under a live waiter."""
+        if self._handle is None:
+            return
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._native_cv:
+            self._closing = True
+            while self._native_inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logging.warning(
+                        "DataLoader.close: %d native next() call(s) still "
+                        "in flight after %.0fs; leaking the native handle "
+                        "instead of freeing it under a live waiter",
+                        self._native_inflight, timeout_s)
+                    self._handle = None
+                    return
+                self._native_cv.wait(min(0.2, remaining))
+            handle, self._handle = self._handle, None
+        self._lib.dl_destroy(handle)
 
     def __del__(self):
         try:
@@ -310,28 +366,32 @@ class DataLoader:
             pass
 
 
-def device_prefetch(loader: DataLoader, runner, depth: int = 2,
-                    unroll: int = 1):
-    """Iterator of on-device sharded batches, ``depth`` transfers ahead.
+def device_prefetch(loader, runner, depth: int = 2, unroll: int = 1,
+                    workers: Optional[int] = None):
+    """Iterator of on-device sharded batches, ``depth`` transfers ahead —
+    a thin wrapper over the unified async pipeline
+    (:func:`autodist_tpu.data.prefetch.prefetch_to_device`).
 
-    ``runner.shard_batch`` is the feed remapping (split over data axes /
-    replicate); issuing it ahead of consumption overlaps host->HBM transfer with
-    the running step — the TPU analogue of the reference's staged input queues.
+    A background producer pulls from the host ``loader`` (any iterable of
+    host batches) and applies ``runner.shard_batch`` (the feed remapping:
+    split over data axes / replicate) ``depth`` ahead of consumption, so
+    BOTH host batch assembly and host->HBM transfer overlap the running
+    step — the TPU analogue of the reference's staged input queues. A
+    finite loader ends iteration cleanly (no PEP 479 ``RuntimeError``);
+    a loader exception re-raises at ``next()``; the returned producer's
+    ``close()`` (also a context manager) shuts the thread down.
 
     With ``unroll=K`` (K > 1) each yielded item is instead a pre-sharded
     :class:`~autodist_tpu.runner.BatchBlock` stacking K consecutive loader
     batches (``runner.shard_block``) for the fused multi-step path
     (``runner.run_many``); ``depth`` then counts blocks, so the queue keeps
-    ``depth * K`` steps of data in flight.
+    ``depth * K`` steps of data in flight. A source that exhausts mid-block
+    drops the partial remainder (logged) and ends cleanly.
+
+    ``workers`` (default ``AUTODIST_PREFETCH_WORKERS``) parallelizes the
+    shard/stack stage; loader pulls stay serialized and emission order is
+    the loader order.
     """
-    import collections
-    pending = collections.deque()
-    it = iter(loader)
-    while True:
-        while len(pending) < max(1, depth):
-            if unroll > 1:
-                pending.append(
-                    runner.shard_block([next(it) for _ in range(unroll)]))
-            else:
-                pending.append(runner.shard_batch(next(it)))
-        yield pending.popleft()
+    from autodist_tpu.data import prefetch as _prefetch
+    return _prefetch.prefetch_to_device(loader, runner, depth=depth,
+                                        unroll=unroll, workers=workers)

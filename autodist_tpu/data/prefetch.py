@@ -1,0 +1,620 @@
+"""Pod-scale input-data plane: one async sharded prefetch pipeline.
+
+MLPerf pod-scale experience (PAPERS.md 1909.09756) calls input stalls *the*
+dominant bottleneck once compute and comms are tuned, and PR 9/11 made the
+stall visible (``train.attr.data_wait``, the ``data_wait_drift`` alert) —
+this module is the layer that *hides* it. One producer/queue core serves
+every input path in the repo instead of three ad-hoc pipelines:
+
+- :class:`BoundedQueue` — a bounded, closeable, thread-safe FIFO with
+  GL005-clean bounded waits. The staging core: the prefetch producers emit
+  into one, and the serving batchers' admission queues
+  (:mod:`autodist_tpu.serving.batcher`) stage requests on the same class.
+- :class:`PrefetchProducer` — a bounded-depth background producer
+  (``workers`` threads; source pulls stay serialized and ordered, the
+  transform — sharding, stacking, ``device_put`` — parallelizes) that
+  re-raises producer exceptions at the consumer, ends cleanly on source
+  exhaustion, and shuts down without leaking blocked threads. Telemetry:
+  a ``data.producer_wait`` seconds counter (time spent blocked on the host
+  loader — the slow loader stays *visible* even when the step no longer
+  stalls), a ``data.queue_depth`` gauge, and ``data.prefetch`` spans.
+- :func:`prefetch_to_device` — the producer composed with the runner's feed
+  layout: pulls host batches, optionally reduces them to this process's
+  shard of the global batch (:func:`host_shard` /
+  :func:`assemble_global_batch`, keyed off :meth:`DistributedRunner.
+  feed_layout`), and issues the async ``shard_batch``/``shard_block``
+  transfers ``depth`` ahead so host loading AND host->HBM transfer overlap
+  the running step. ``data.loader.device_prefetch`` is a thin wrapper;
+  ``train(prefetch_depth=K)`` drives both loops through the same producer.
+
+This module stays jax-free at import time (the serving batcher imports the
+queue core and is deliberately jax-free); jax is imported lazily inside the
+placement helpers.
+"""
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from autodist_tpu import const, telemetry
+from autodist_tpu.utils import logging
+
+__all__ = ["BoundedQueue", "QueueClosed", "EMPTY", "PrefetchProducer",
+           "prefetch_to_device", "host_shard_rows", "host_shard",
+           "assemble_global_batch", "default_prefetch_depth",
+           "default_prefetch_workers"]
+
+
+class QueueClosed(RuntimeError):
+    """Raised by :meth:`BoundedQueue.try_put` / empty :meth:`BoundedQueue.get`
+    after :meth:`BoundedQueue.close` — and by a consumer iterating a
+    :class:`PrefetchProducer` that was closed under it."""
+
+
+# get()/pop_nowait() "nothing there" sentinel — distinct from any item
+# (queues legitimately carry None).
+EMPTY = object()
+
+
+def default_prefetch_depth() -> int:
+    """The ``AUTODIST_PREFETCH_DEPTH`` flag's value (0 = synchronous feed)."""
+    return max(0, int(const.ENV.AUTODIST_PREFETCH_DEPTH.val))
+
+
+def default_prefetch_workers() -> int:
+    """The ``AUTODIST_PREFETCH_WORKERS`` flag's value (>= 1)."""
+    return max(1, int(const.ENV.AUTODIST_PREFETCH_WORKERS.val))
+
+
+class BoundedQueue:
+    """Bounded thread-safe FIFO with close semantics and bounded waits.
+
+    The ONE staging core behind the input plane: prefetch producers emit
+    into one, the serving batchers stage admissions on one. Semantics:
+
+    - ``try_put`` never blocks: ``False`` when full, :class:`QueueClosed`
+      once closed (better an instant rejection than an unbounded queue).
+    - ``put`` blocks in bounded polls until space; returns ``False`` when
+      the queue closes under it (a producer's exit signal, not an error).
+    - ``get``/``pop_nowait`` DRAIN after close (items enqueued before the
+      close are still delivered); an empty closed queue raises
+      :class:`QueueClosed` from ``get`` so a consumer can't park forever.
+    - every wait is bounded (GL005): waiters poll at :data:`POLL_S` and
+      re-check the closed flag, so ``close()`` never strands a thread.
+    """
+
+    POLL_S = 0.2   # per-wait bound; loops re-check closed/deadline
+
+    def __init__(self, capacity: int):
+        # capacity 0 is a valid reject-everything queue (the serving
+        # batcher's max_queue=0 drain configuration): try_put always
+        # returns False, put blocks until close.
+        if capacity < 0:
+            raise ValueError(f"BoundedQueue capacity must be >= 0, got "
+                             f"{capacity}")
+        self.capacity = int(capacity)
+        self._items: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def try_put(self, item) -> bool:
+        """Non-blocking put: True on success, False when full; raises
+        :class:`QueueClosed` once closed."""
+        with self._cond:
+            if self._closed:
+                raise QueueClosed("queue is closed")
+            if len(self._items) >= self.capacity:
+                return False
+            self._items.append(item)
+            self._cond.notify_all()
+            return True
+
+    def put(self, item, timeout_s: Optional[float] = None) -> bool:
+        """Blocking put (bounded polls). True on success; False when the
+        queue closed while waiting, or ``timeout_s`` expired."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                if self._closed:
+                    return False
+                if len(self._items) < self.capacity:
+                    self._items.append(item)
+                    self._cond.notify_all()
+                    return True
+                wait = self.POLL_S
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+
+    def get(self, timeout_s: Optional[float] = None):
+        """Next item; :data:`EMPTY` on timeout; :class:`QueueClosed` when
+        the queue is closed AND drained (pre-close items still deliver)."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        with self._cond:
+            while True:
+                if self._items:
+                    item = self._items.popleft()
+                    self._cond.notify_all()
+                    return item
+                if self._closed:
+                    raise QueueClosed("queue is closed and drained")
+                wait = self.POLL_S
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return EMPTY
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+
+    def pop_nowait(self):
+        """Non-blocking get: the next item or :data:`EMPTY` (works on a
+        closed queue too — the drain path)."""
+        with self._cond:
+            if not self._items:
+                return EMPTY
+            item = self._items.popleft()
+            self._cond.notify_all()
+            return item
+
+    def wait_nonempty(self, timeout_s: float) -> bool:
+        """Park (bounded) until an item is available or the queue closes;
+        True when an item is waiting."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while not self._items and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(self.POLL_S, remaining))
+            return bool(self._items)
+
+    def close(self) -> List[Any]:
+        """Close and drain: wakes every blocked putter/getter, returns the
+        undelivered items (the serving batcher fails them back to their
+        clients). Idempotent."""
+        with self._cond:
+            self._closed = True
+            drained = list(self._items)
+            self._items.clear()
+            self._cond.notify_all()
+            return drained
+
+
+# ------------------------------------------------------------- the producer
+
+class PrefetchProducer:
+    """Bounded-depth background producer with ordered emission.
+
+    ``pull()`` returns the next source item (called under one source lock,
+    strictly in order — host loaders and user batch callables are not
+    thread-safe) or raises ``StopIteration`` at exhaustion; ``transform``
+    (sharding / block stacking / async ``device_put``) runs OUTSIDE the
+    source lock, so ``workers > 1`` parallelizes the transform stage while
+    emission order stays the pull order (a per-sequence turnstile).
+
+    Consumer contract (the iterator protocol):
+
+    - items arrive in pull order, at most ``depth`` buffered ahead;
+    - a producer-side exception re-raises at the consumer's ``next()``, in
+      sequence position (items pulled before it deliver first). An error
+      FORFEITS the readahead: items other workers pulled past the failing
+      sequence are dropped at close, so a one-shot source that was read
+      ahead cannot be resumed loss-free by a fresh producer (re-pulling
+      would reorder; restart the source instead);
+    - source exhaustion ends iteration cleanly (``StopIteration`` — never
+      the bare PEP 479 ``RuntimeError`` the old generator path leaked);
+    - ``close()`` is prompt even with a producer blocked on a full queue
+      or a consumer parked on an empty one (all waits are bounded), and
+      idempotent; iterating a closed producer raises :class:`QueueClosed`.
+
+    Telemetry (always-on counters — a few dict ops per batch, the serving
+    SLO precedent; spans only when telemetry is enabled):
+
+    - ``<prefix>.producer_wait`` counter: seconds the producer spent
+      blocked pulling from the host source. THE slow-loader signal: when
+      prefetch hides the stall, ``train.attr.data_wait`` goes quiet but
+      this keeps naming the loader.
+    - ``<prefix>.producer_batches`` counter, ``<prefix>.queue_depth``
+      gauge, and a ``<prefix>.prefetch`` span per produced item.
+    """
+
+    JOIN_S = 30.0            # bounded close-side join (threads are daemons)
+    NEXT_TIMEOUT_S = 86400.0  # consumer backstop: a wedged producer with no
+    #                           end/error marker must not park next() forever
+
+    def __init__(self, pull: Callable[[], Any],
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 depth: int = 2, workers: int = 1, name: str = "prefetch",
+                 metric_prefix: str = "data"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if workers < 1:
+            raise ValueError(f"prefetch workers must be >= 1, got {workers}")
+        self._pull = pull
+        self._transform = transform
+        self._queue = BoundedQueue(depth)
+        self._prefix = metric_prefix
+        self._src_lock = threading.Lock()
+        self._turn = threading.Condition()
+        self._next_seq = 0        # next pull sequence (under _src_lock)
+        self._next_emit = 0       # next sequence allowed to emit (under _turn)
+        self._stop = threading.Event()
+        self._src_done = False    # producer side: no more pulls (under _src_lock)
+        self._consumer_done = False
+        self._wait_c = telemetry.counter(f"{metric_prefix}.producer_wait")
+        self._batch_c = telemetry.counter(f"{metric_prefix}.producer_batches")
+        self._depth_g = telemetry.gauge(f"{metric_prefix}.queue_depth")
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"{name}-{i}")
+            for i in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------- producer
+
+    def _work(self):
+        while not self._stop.is_set():
+            kind, value = "item", None
+            with self._src_lock:
+                if self._src_done or self._stop.is_set():
+                    return
+                seq = self._next_seq
+                self._next_seq += 1
+                t0 = time.perf_counter()
+                try:
+                    value = self._pull()
+                except StopIteration:
+                    self._src_done = True
+                    kind = "end"
+                except BaseException as e:  # noqa: BLE001 — re-raised at the
+                    self._src_done = True   # consumer, never swallowed
+                    kind, value = "error", e
+                wait_s = time.perf_counter() - t0
+            if kind == "item":
+                self._wait_c.inc(wait_s)
+                self._batch_c.inc()
+                if self._transform is not None:
+                    try:
+                        with telemetry.span(f"{self._prefix}.prefetch",
+                                            seq=seq):
+                            value = self._transform(value)
+                    except BaseException as e:  # noqa: BLE001 — same contract
+                        with self._src_lock:
+                            self._src_done = True
+                        kind, value = "error", e
+            self._emit(seq, (kind, value))
+            if kind != "item":
+                return
+
+    def _emit(self, seq: int, payload):
+        """Ordered emission: wait (bounded) for this sequence's turn, push,
+        advance the turnstile. The advance happens even when the push is
+        skipped (stop/closed), so peers waiting on later turns never park."""
+        with self._turn:
+            while self._next_emit != seq and not self._stop.is_set():
+                self._turn.wait(BoundedQueue.POLL_S)
+        if not self._stop.is_set():
+            self._queue.put(payload)   # False when closed under us: fine
+            self._depth_g.set(len(self._queue))
+        with self._turn:
+            self._next_emit = max(self._next_emit, seq + 1)
+            self._turn.notify_all()
+
+    # ------------------------------------------------------------- consumer
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._consumer_done:
+            raise StopIteration
+        deadline = time.monotonic() + self.NEXT_TIMEOUT_S
+        while True:
+            try:
+                item = self._queue.get(timeout_s=BoundedQueue.POLL_S)
+            except QueueClosed:
+                raise QueueClosed("prefetch producer is closed") from None
+            if item is EMPTY:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"prefetch consumer waited "
+                        f"{self.NEXT_TIMEOUT_S:.0f}s with no item, end "
+                        f"marker, or error — the producer is wedged")
+                continue
+            self._depth_g.set(len(self._queue))
+            kind, value = item
+            if kind == "item":
+                return value
+            self._consumer_done = True
+            if kind == "end":
+                raise StopIteration
+            raise value   # the producer-side exception, at its position
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def close(self, timeout_s: Optional[float] = None):
+        """Stop the workers and drop buffered items. Prompt even with a
+        producer blocked on a full queue; a producer parked inside a long
+        source pull exits at the pull's return (the join is bounded and the
+        threads are daemons — close never hangs the caller)."""
+        self._stop.set()
+        self._queue.close()
+        with self._turn:
+            self._turn.notify_all()
+        deadline = time.monotonic() + (self.JOIN_S if timeout_s is None
+                                       else timeout_s)
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self._stop.set()
+            self._queue.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+# ------------------------------------------------------ per-host sharding
+
+def _modal_leading_dim(leaves, batch_rows: Optional[int] = None
+                       ) -> Optional[int]:
+    """The batch's row count: the most common leading dim across array
+    leaves — the runner's modal-batch-dim rule, INCLUDING its refusal to
+    guess: two equally common candidate dims raise instead of silently
+    sharding the wrong leaf (pass ``batch_rows=`` to resolve explicitly,
+    the runner's ``batch_size=`` analogue)."""
+    if batch_rows is not None:
+        return int(batch_rows)
+    dims: Dict[int, int] = {}
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape and len(shape) >= 1:
+            dims[shape[0]] = dims.get(shape[0], 0) + 1
+    if not dims:
+        return None
+    top = max(dims.values())
+    modal = [d for d, n in dims.items() if n == top]
+    if len(modal) > 1:
+        raise ValueError(
+            f"ambiguous batch dim: leading dims {sorted(modal)} are equally "
+            f"common across the batch's leaves; pass batch_rows= to name "
+            f"the batch dimension explicitly (the runner's batch_size= "
+            f"rule — guessing would silently shard the wrong leaf)")
+    return modal[0]
+
+
+def host_shard_rows(n_rows: int, process_id: int,
+                    num_processes: int) -> Tuple[int, int]:
+    """The contiguous ``[start, stop)`` row block of an ``n_rows`` global
+    batch that process ``process_id`` of ``num_processes`` materializes —
+    the canonical per-host layout (process blocks tile the batch in rank
+    order). Blocks are disjoint and cover every row exactly once."""
+    if not 0 <= process_id < num_processes:
+        raise ValueError(f"process_id {process_id} out of "
+                         f"[0, {num_processes})")
+    if n_rows % num_processes != 0:
+        raise ValueError(
+            f"global batch of {n_rows} rows does not tile over "
+            f"{num_processes} processes; make it divisible")
+    per = n_rows // num_processes
+    return process_id * per, (process_id + 1) * per
+
+
+def host_shard(batch: Any, process_id: Optional[int] = None,
+               num_processes: Optional[int] = None,
+               batch_rows: Optional[int] = None) -> Any:
+    """Slice a GLOBAL host batch down to this process's contiguous row
+    block (:func:`host_shard_rows`); non-batch leaves (leading dim != the
+    modal batch dim — ambiguity raises, ``batch_rows=`` resolves it) pass
+    through whole (they replicate). The loader-side half of per-host
+    sharded loading — pair with :func:`assemble_global_batch` on the
+    device side, or prep the shards with ``shard_files_for_process`` so
+    each host never loads foreign rows at all."""
+    import jax
+
+    if num_processes is None:
+        num_processes = jax.process_count()
+    if process_id is None:
+        process_id = jax.process_index()
+    if num_processes == 1:
+        return batch
+    leaves = jax.tree_util.tree_leaves(batch)
+    n_rows = _modal_leading_dim(leaves, batch_rows)
+    if n_rows is None:
+        return batch
+    start, stop = host_shard_rows(n_rows, process_id, num_processes)
+
+    def cut(leaf):
+        shape = getattr(leaf, "shape", None)
+        if shape and len(shape) >= 1 and shape[0] == n_rows:
+            return leaf[start:stop]
+        return leaf
+
+    return jax.tree_util.tree_map(cut, batch)
+
+
+def assemble_global_batch(runner, local_batch: Any,
+                          process_id: Optional[int] = None,
+                          num_processes: Optional[int] = None,
+                          batch_rows: Optional[int] = None) -> Any:
+    """The device-side half of per-host sharded loading: build the GLOBAL
+    sharded batch from this process's LOCAL rows, keyed off the runner's
+    feed layout (:meth:`DistributedRunner.feed_layout`).
+
+    Each batch leaf arrives as ``[B/num_processes, ...]`` local rows; the
+    global array is assembled via per-shard callbacks
+    (``jax.make_array_from_callback``), so no process ever materializes
+    another's bytes — the ShardedPrefetchedLoader pattern (SNIPPETS.md
+    [3]), and the multi-host contract ``place_host_value``'s full-value
+    callback path cannot offer. Requires the feed layout to hand this
+    process exactly its contiguous row block (the canonical data-major
+    mesh layout; a layout that interleaves rows across processes raises
+    with the offending range named). Non-batch leaves replicate whole.
+
+    Gradient accumulation's micro layout is not supported here (the
+    ``[k, B/k]`` reshape needs the global batch); feed global batches or
+    drop accumulation on per-host pipelines."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    layout = runner.feed_layout()
+    if layout.accum > 1:
+        raise ValueError(
+            "assemble_global_batch does not support accumulation_steps > 1 "
+            "(the micro-batch [k, B/k] reshape needs the global batch); "
+            "feed global batches through shard_batch instead")
+    if num_processes is None:
+        num_processes = jax.process_count()
+    if process_id is None:
+        process_id = jax.process_index()
+    leaves = jax.tree_util.tree_leaves(local_batch)
+    local_rows = _modal_leading_dim(leaves, batch_rows)
+
+    def put(leaf):
+        arr = leaf if isinstance(leaf, np.ndarray) else np.asarray(leaf)
+        shape = arr.shape
+        is_batch = (local_rows is not None and len(shape) >= 1
+                    and shape[0] == local_rows)
+        if is_batch:
+            global_n = shape[0] * num_processes
+            if global_n % layout.dp != 0:
+                # Replicating is not a fallback here: each process holds
+                # DIFFERENT local rows, so an unsplittable batch leaf
+                # cannot be assembled at all — name the problem instead
+                # of letting the callback fail with a far-away shape error.
+                raise ValueError(
+                    f"global batch of {global_n} rows "
+                    f"({shape[0]} local x {num_processes} processes) does "
+                    f"not split over the mesh's data extent "
+                    f"(dp={layout.dp}); per-host assembly needs the global "
+                    f"row count divisible by dp")
+            global_shape = (global_n,) + shape[1:]
+            spec = layout.batch_pspec(len(global_shape))
+        else:
+            global_shape = shape
+            spec = P()
+        sharding = NamedSharding(layout.mesh, spec)
+        off = process_id * shape[0] if is_batch and spec != P() else 0
+
+        def cb(idx):
+            rows = idx[0] if idx else slice(None)
+            start = rows.start or 0
+            stop = rows.stop if rows.stop is not None else global_shape[0]
+            if is_batch and spec != P():
+                if start < off or stop > off + shape[0]:
+                    raise ValueError(
+                        f"feed layout asks process {process_id} for rows "
+                        f"[{start}, {stop}) outside its local block "
+                        f"[{off}, {off + shape[0]}) — the mesh's data axes "
+                        f"do not tile processes into contiguous row blocks; "
+                        f"feed global batches instead")
+                return arr[(slice(start - off, stop - off),) + tuple(idx[1:])]
+            return arr[tuple(idx)]
+
+        return jax.make_array_from_callback(global_shape, sharding, cb)
+
+    return jax.tree_util.tree_map(put, local_batch)
+
+
+# ------------------------------------------------------------ device feed
+
+def _as_pull(source) -> Callable[[], Any]:
+    """Normalize a source — iterator/iterable (a :class:`DataLoader`, a
+    generator, a list) or a 0-arg callable — into the producer's ``pull``."""
+    if callable(source) and not hasattr(source, "__iter__") \
+            and not hasattr(source, "__next__"):
+        return source
+    it = iter(source)
+    return lambda: next(it)
+
+
+def prefetch_to_device(source, runner, depth: int = 2, unroll: int = 1,
+                       workers: Optional[int] = None, per_host: bool = False,
+                       process_id: Optional[int] = None,
+                       num_processes: Optional[int] = None,
+                       name: str = "device-prefetch") -> PrefetchProducer:
+    """The unified async device feed: a :class:`PrefetchProducer` whose
+    transform is the runner's feed remapping, issuing ``shard_batch`` /
+    ``shard_block`` transfers ``depth`` ahead so host loading and
+    host->HBM transfer both overlap the running step.
+
+    ``source``: a loader / iterable of host batches, or a 0-arg callable.
+    With ``unroll=K`` each emitted item is a pre-sharded
+    :class:`~autodist_tpu.runner.BatchBlock` of K consecutive batches
+    (``depth`` then counts blocks, so ``depth * K`` steps stay in flight);
+    a source that exhausts mid-block yields nothing for the partial block
+    — the dropped remainder is logged, iteration ends cleanly.
+
+    ``per_host=True``: the source yields this process's LOCAL rows
+    (``global_batch / num_processes`` per batch — e.g. a loader over
+    ``shard_files_for_process`` shards) and the producer assembles the
+    global array from them (:func:`assemble_global_batch`); single-step
+    feed only (blocks stack globally).
+    """
+    if unroll < 1:
+        raise ValueError("unroll must be >= 1")
+    if workers is None:
+        workers = default_prefetch_workers()
+    depth = max(1, int(depth))
+    src = _as_pull(source)
+
+    if per_host:
+        if unroll > 1:
+            raise ValueError("per_host prefetch supports unroll=1 only "
+                             "(blocks stack the global batch)")
+        transform = lambda b: assemble_global_batch(  # noqa: E731
+            runner, b, process_id=process_id, num_processes=num_processes)
+        return PrefetchProducer(src, transform, depth=depth, workers=workers,
+                                name=name)
+
+    if unroll > 1:
+        done = [False]
+
+        def pull_block():
+            if done[0]:
+                raise StopIteration
+            blk = []
+            for _ in range(unroll):
+                try:
+                    blk.append(src())
+                except StopIteration:
+                    done[0] = True
+                    if blk:
+                        logging.info(
+                            "prefetch: source exhausted mid-block; dropping "
+                            "the %d-batch remainder (unroll=%d)",
+                            len(blk), unroll)
+                    raise StopIteration from None
+            return blk
+
+        return PrefetchProducer(pull_block, runner.shard_block, depth=depth,
+                                workers=workers, name=name)
+
+    return PrefetchProducer(src, runner.shard_batch, depth=depth,
+                            workers=workers, name=name)

@@ -52,6 +52,24 @@ def place_host_value(leaf, sharding) -> jax.Array:
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
+@dataclasses.dataclass(frozen=True)
+class FeedLayout:
+    """The static description of a runner's feed remapping — what the
+    input-data plane (:mod:`autodist_tpu.data.prefetch`) keys per-host
+    sharding and async transfers off, so a prefetch pipeline can never
+    place a batch differently than :meth:`DistributedRunner.shard_batch`
+    would. ``dp`` is the data-parallel extent, ``accum`` the micro-batch
+    split, ``batch_pspec(ndim)`` the plan's batch partition spec."""
+
+    mesh: Any
+    plan: Any
+    dp: int
+    accum: int
+
+    def batch_pspec(self, ndim: int):
+        return self.plan.batch_pspec(ndim)
+
+
 @jax.tree_util.register_pytree_node_class
 class MicroBatched:
     """Marker wrapping a batch leaf laid out ``[accum_steps, micro_batch, ...]``.
@@ -529,6 +547,15 @@ class DistributedRunner:
                 f"accumulation_steps={k} micro-batches over {dp} data "
                 f"replicas; make it divisible by {k * dp} (or drop "
                 f"accumulation)")
+
+    def feed_layout(self) -> FeedLayout:
+        """This runner's feed remapping as data (:class:`FeedLayout`) —
+        the input-data plane's key for per-host sharded loading and
+        prefetch placement (one layout source, shared with
+        :meth:`shard_batch`/:meth:`shard_block`)."""
+        return FeedLayout(mesh=self.mesh, plan=self.plan,
+                          dp=synchronization.mesh_dp_size(self.mesh),
+                          accum=self._accum)
 
     def shard_batch(self, batch: PyTree,
                     accumulation: Optional[int] = None) -> PyTree:

@@ -33,7 +33,6 @@ counters. Host spans (``serve.prefill``, ``serve.decode_step``) appear in the
 PR 5 cluster trace when telemetry is enabled.
 """
 
-import collections
 import dataclasses
 import itertools
 import threading
@@ -43,6 +42,11 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from autodist_tpu import telemetry
+# The admission queue is the input-data plane's staging core (BoundedQueue:
+# bounded, closeable, GL005-clean waits) — ONE queue implementation behind
+# the prefetch producers and the serving batchers. data.prefetch stays
+# jax-free at import, preserving this module's jax-free contract.
+from autodist_tpu.data.prefetch import EMPTY, BoundedQueue, QueueClosed
 
 
 class ServeError(RuntimeError):
@@ -233,9 +237,10 @@ class _BatcherBase:
         self._engine = engine
         self.config = config
         self._metrics = _ServeMetrics()
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)
-        self._waiting: collections.deque = collections.deque()
+        self._lock = threading.Lock()          # slot/engine state
+        # Admission staging on the shared input-plane queue core: bounded
+        # (max_queue), instant-rejection try_put, close-and-drain shutdown.
+        self._waiting = BoundedQueue(config.max_queue)
         self._rid = itertools.count()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -254,27 +259,28 @@ class _BatcherBase:
         queue whose tail latency is infinite. O(1) host work — anything
         per-request and device-touching happens at admission, not here."""
         req.deadline = req.t_submit + self.config.request_timeout_s
-        with self._work:
-            if self._stop.is_set():
-                # After close() no loop thread exists to ever serve this;
-                # reject now instead of parking the caller for its full
-                # timeout on a queue nobody drains.
-                self._metrics.rejected.inc()
-                raise ServeError("server is shutting down")
-            if len(self._waiting) >= self.config.max_queue:
-                self._metrics.rejected.inc()
-                raise ServeError(
-                    f"serving queue is full ({self.config.max_queue} "
-                    f"waiting); retry later")
-            self._waiting.append(req)
-            self._metrics.submitted.inc()
-            self._metrics.depth.set(len(self._waiting))
-            self._work.notify()
+        if self._stop.is_set():
+            # After close() no loop thread exists to ever serve this;
+            # reject now instead of parking the caller for its full
+            # timeout on a queue nobody drains.
+            self._metrics.rejected.inc()
+            raise ServeError("server is shutting down")
+        try:
+            admitted = self._waiting.try_put(req)
+        except QueueClosed:
+            self._metrics.rejected.inc()
+            raise ServeError("server is shutting down") from None
+        if not admitted:
+            self._metrics.rejected.inc()
+            raise ServeError(
+                f"serving queue is full ({self.config.max_queue} "
+                f"waiting); retry later")
+        self._metrics.submitted.inc()
+        self._metrics.depth.set(len(self._waiting))
         return req
 
     def queue_depth(self) -> int:
-        with self._lock:
-            return len(self._waiting)
+        return len(self._waiting)
 
     def _inflight_locked(self) -> List[ServeRequest]:
         """Hook (called under ``_lock`` from :meth:`close`): active requests
@@ -290,15 +296,15 @@ class _BatcherBase:
 
     def close(self):
         self._stop.set()
-        with self._work:
-            self._work.notify()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
         # Fail whatever is still queued/in-flight so no handler waits out its
-        # full timeout on a server that is gone.
+        # full timeout on a server that is gone. Closing the staging queue
+        # AFTER the join also converts racing late submits into instant
+        # shutting-down rejections (QueueClosed in _enqueue).
+        pending = self._waiting.close()
         with self._lock:
-            pending = list(self._waiting) + self._inflight_locked()
-            self._waiting.clear()
+            pending += self._inflight_locked()
         for req in pending:
             req.finish(error="server shutting down")
 
@@ -320,10 +326,10 @@ class _BatcherBase:
                 _logging.warning("serving: %s (AUTODIST_ALERT_ACTION=halt "
                                  "does not stop the scheduler loop; drain "
                                  "via the router instead)", e)
-            if not self.run_once():
-                with self._work:
-                    if not self._waiting and not self._stop.is_set():
-                        self._work.wait(self.IDLE_WAIT_S)  # bounded idle poll
+            if not self.run_once() and not self._stop.is_set():
+                # Bounded idle poll on the staging queue (wakes instantly
+                # on an admission, at IDLE_WAIT_S otherwise).
+                self._waiting.wait_nonempty(self.IDLE_WAIT_S)
 
     def _drop_dead(self, req: ServeRequest):
         """A request whose client stopped waiting (abandoned) or whose
@@ -477,18 +483,22 @@ class Batcher(_BatcherBase):
         dropped: List[ServeRequest] = []
         with self._lock:
             free = [s for s, r in enumerate(self._slots) if r is None]
-            if not self._waiting or not free:
-                return
-            if self.config.mode == "static" and len(free) != len(self._slots):
-                return
-            batch: List[Tuple[int, ServeRequest]] = []
-            while free and self._waiting:
-                req = self._waiting.popleft()
-                if req.dead(now):
-                    dropped.append(req)
-                    continue
-                batch.append((free.pop(0), req))
-            self._metrics.depth.set(len(self._waiting))
+            n_slots = len(self._slots)
+        if not len(self._waiting) or not free:
+            return
+        if self.config.mode == "static" and len(free) != n_slots:
+            return
+        batch: List[Tuple[int, ServeRequest]] = []
+        while free:
+            req = self._waiting.pop_nowait()
+            if req is EMPTY:
+                break
+            if req.dead(now):
+                dropped.append(req)
+                continue
+            batch.append((free.pop(0), req))
+        self._metrics.depth.set(len(self._waiting))
+        with self._lock:
             for slot, req in batch:
                 self._slots[slot] = req
         for req in dropped:
@@ -556,12 +566,13 @@ class ApplyBatcher(_BatcherBase):
     def run_once(self) -> bool:
         now = time.perf_counter()
         dropped: List[ServeRequest] = []
-        with self._lock:
-            batch: List[ServeRequest] = []
-            while self._waiting and len(batch) < self._engine.capacity:
-                req = self._waiting.popleft()
-                (dropped if req.dead(now) else batch).append(req)
-            self._metrics.depth.set(len(self._waiting))
+        batch: List[ServeRequest] = []
+        while len(batch) < self._engine.capacity:
+            req = self._waiting.pop_nowait()
+            if req is EMPTY:
+                break
+            (dropped if req.dead(now) else batch).append(req)
+        self._metrics.depth.set(len(self._waiting))
         for req in dropped:
             self._drop_dead(req)
         if not batch:

@@ -61,6 +61,14 @@ PLAN_SCHEMA_VERSION = 1
 # unroll_curve).
 DEFAULT_UNROLLS = (1, 2, 4, 8)
 
+# The prefetch depths ranked when the tuning problem declares a loader cost
+# (``loader_s_per_step > 0``). Two points suffice: the cost model prices
+# the steady-state pipeline (any depth >= 1 sustains max(rest_s, loader_s)
+# — depth beyond that only smooths jitter), so 0-vs-on is the real
+# decision; 2 is the shipped on-value (double buffering). Without a loader
+# cost the knob collapses to (0,) and the space is unchanged.
+DEFAULT_PREFETCH_DEPTHS = (0, 2)
+
 # Stage-1 prune margin: a candidate predicted more than this fraction slower
 # than the frontrunner is dropped without measurement. Wide by design — the
 # calibrated model ranks, it does not referee photo finishes; anything
@@ -124,6 +132,7 @@ class Candidate:
     accumulation_steps: int = 1
     zero: int = 0
     overlap: bool = True                  # async-PS prefetch client knob
+    prefetch_depth: int = 0               # input-pipeline prefetch knob
     asynchronous: bool = False            # async regime: predicted, not probed
     why: str = ""                         # enumeration reason
     predicted: Optional[Dict[str, Any]] = None   # costmodel.predict output
@@ -139,6 +148,8 @@ class Candidate:
             knobs.append(f"accum={self.accumulation_steps}")
         if self.zero:
             knobs.append(f"zero={self.zero}")
+        if self.prefetch_depth:
+            knobs.append(f"pf={self.prefetch_depth}")
         if self.asynchronous:
             knobs.append("async" + ("" if self.overlap else ",overlap=0"))
         base = self.builder_spec["name"]
@@ -149,9 +160,11 @@ class Candidate:
 
     def base_key(self) -> Tuple:
         """The compile-probe grouping key: candidates differing only in
-        ``unroll``/``overlap`` share one probed base program (the fused
-        block's cost is the scanned body's x K — the same scaling rule the
-        runner's cost extraction already applies)."""
+        ``unroll``/``overlap``/``prefetch_depth`` share one probed base
+        program (the fused block's cost is the scanned body's x K — the
+        same scaling rule the runner's cost extraction already applies —
+        and the prefetch producer changes the host pipeline, not the
+        compiled program)."""
         return (self.builder_spec["name"],
                 tuple(sorted((self.builder_spec.get("kwargs") or {}).items())),
                 self.accumulation_steps, self.zero, self.asynchronous)
@@ -170,6 +183,7 @@ class TunedPlan:
     accumulation_steps: int = 1
     zero: int = 0
     overlap: bool = True
+    prefetch_depth: int = 0
     predicted: Optional[Dict[str, Any]] = None
     measured_steps_per_s: Optional[float] = None
     cache_key: str = ""
@@ -186,13 +200,15 @@ class TunedPlan:
     def name(self) -> str:
         c = Candidate(self.builder_spec, unroll=self.unroll,
                       accumulation_steps=self.accumulation_steps,
-                      zero=self.zero, overlap=self.overlap)
+                      zero=self.zero, overlap=self.overlap,
+                      prefetch_depth=self.prefetch_depth)
         return c.name
 
     def knobs_dict(self) -> Dict[str, Any]:
         return {"builder": self.builder_spec, "unroll": self.unroll,
                 "accumulation_steps": self.accumulation_steps,
-                "zero": self.zero, "overlap": self.overlap}
+                "zero": self.zero, "overlap": self.overlap,
+                "prefetch_depth": self.prefetch_depth}
 
     def to_dict(self) -> Dict[str, Any]:
         """The cache entry / profile-manifest record: knobs + prediction +
@@ -217,6 +233,7 @@ class TunedPlan:
                    accumulation_steps=int(knobs.get("accumulation_steps") or 1),
                    zero=int(knobs.get("zero") or 0),
                    overlap=bool(knobs.get("overlap", True)),
+                   prefetch_depth=int(knobs.get("prefetch_depth") or 0),
                    predicted=d.get("predicted"),
                    measured_steps_per_s=d.get("measured_steps_per_s"),
                    cache_key=d.get("cache_key") or "",
@@ -254,7 +271,8 @@ class TunedPlan:
                 if (c.builder_spec == self.builder_spec
                         and c.unroll == self.unroll
                         and c.accumulation_steps == self.accumulation_steps
-                        and c.zero == self.zero):
+                        and c.zero == self.zero
+                        and c.prefetch_depth == self.prefetch_depth):
                     tail += "  <- winner"
             elif c.probe is not None:
                 tail = f"probe: {c.probe.error}"
@@ -384,7 +402,9 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
                          unrolls: Sequence[int] = DEFAULT_UNROLLS,
                          accums: Sequence[int] = (1,),
                          include_async: Optional[bool] = None,
-                         budget: Optional[int] = None) -> List[Candidate]:
+                         budget: Optional[int] = None,
+                         prefetch_depths: Optional[Sequence[int]] = None,
+                         loader_s_per_step: float = 0.0) -> List[Candidate]:
     """The joint candidate space, generated from :class:`AutoStrategy`'s
     analytic rules instead of collapsed to its one answer:
 
@@ -397,8 +417,11 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
       threshold with a partitionable axis admits PartitionedAR (and
       PartitionedPS when memory-bound);
     - **knobs**: each builder crosses ``unroll`` (sync only — the async
-      regime has no fused block), ``accumulation_steps``, and ``zero``
-      (only where the mesh has a data-parallel extent to shard over).
+      regime has no fused block), ``accumulation_steps``, ``zero``
+      (only where the mesh has a data-parallel extent to shard over), and
+      ``prefetch_depth`` (sync only; enumerated only when the tuning
+      problem declares a loader cost — ``loader_s_per_step > 0`` — since
+      without one every depth predicts identically).
 
     Deterministic order (builder priority, then unroll/accum/zero
     ascending), capped at ``budget`` (``AUTODIST_TUNE_BUDGET``) with a log
@@ -450,6 +473,12 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
     # the partition gate reads, so a spec pinning one device never wastes
     # compile probes (or top-k slots) on zero=1 twins of zero=0 programs.
     zeros = [0, 1] if n_dev > 1 else [0]
+    # The prefetch knob only differentiates predictions when the problem
+    # declares a loader cost; without one, every depth prices identically
+    # and enumerating it would only burn budget on twins.
+    if prefetch_depths is None:
+        prefetch_depths = DEFAULT_PREFETCH_DEPTHS \
+            if loader_s_per_step > 0 else (0,)
     out: List[Candidate] = []
     for spec, is_async, why in bases:
         for accum in accums:
@@ -458,6 +487,9 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
                     # The async regime has no fused block and its ZeRO knob
                     # (server-side apply shards) changes no device program;
                     # the client overlap knob is its execution dimension.
+                    # (Its per-step train loop can still prefetch, but the
+                    # knob is not enumerated: async candidates are
+                    # predicted, never measured.)
                     if zero:
                         continue
                     for overlap in (True, False):
@@ -467,9 +499,11 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
                             why=why))
                     continue
                 for unroll in unrolls:
-                    out.append(Candidate(
-                        spec, unroll=int(unroll), accumulation_steps=accum,
-                        zero=zero, why=why))
+                    for depth in prefetch_depths:
+                        out.append(Candidate(
+                            spec, unroll=int(unroll),
+                            accumulation_steps=accum, zero=zero,
+                            prefetch_depth=int(depth), why=why))
     if len(out) > budget:
         logging.warning(
             "autotune: enumerated %d candidates, keeping the first %d "
@@ -617,8 +651,18 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
              calibration: Optional[costmodel.Calibration] = None,
              plan_cache: Optional[str] = None,
              warmup_steps: int = 2, measure_steps: int = 8,
-             include_async: Optional[bool] = None) -> TunedPlan:
+             include_async: Optional[bool] = None,
+             prefetch_depths: Optional[Sequence[int]] = None,
+             loader_s_per_step: float = 0.0) -> TunedPlan:
     """The two-stage plan search. Returns the winning :class:`TunedPlan`.
+
+    ``loader_s_per_step`` declares the input pipeline's measured per-step
+    host-loader seconds (e.g. a timed ``loader.next()``); stage 1 then
+    also enumerates ``prefetch_depth`` (``DEFAULT_PREFETCH_DEPTHS``,
+    override with ``prefetch_depths=``) and prices each candidate's
+    residual data wait as ``max(0, loader_s - hidden_s)`` — the winner's
+    depth rides the plan (``train(prefetch_depth=None)`` adopts it, and
+    the applied-plan manifest records it).
 
     A warm ``plan_cache`` entry (``AUTODIST_PLAN_CACHE`` when None) for this
     (model, batch, topology, version) returns immediately — zero compile
@@ -668,7 +712,8 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
         cands = enumerate_candidates(
             model_spec, resource_spec, optimizer, unrolls=unrolls,
             accums=tuple(accumulation_steps), include_async=include_async,
-            budget=budget)
+            budget=budget, prefetch_depths=prefetch_depths,
+            loader_s_per_step=loader_s_per_step)
         calib, calib_src = _load_calibration(calibration)
         logging.info("autotune [%s]: %d candidates, calibration %s", key,
                      len(cands), calib_src)
@@ -684,7 +729,9 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                 rec = _derive_record(base, c.unroll)
                 c.predicted = costmodel.predict(
                     rec, calib,
-                    comm_bytes_per_step=_comm_bytes_per_step(model_spec, c))
+                    comm_bytes_per_step=_comm_bytes_per_step(model_spec, c),
+                    loader_s_per_step=loader_s_per_step,
+                    prefetch_depth=c.prefetch_depth)
         predicted = [c for c in cands if c.predicted is not None]
         if not predicted:
             raise RuntimeError(
@@ -693,6 +740,12 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
         best_pred = min(c.predicted["step_s"] for c in predicted)
         ranked = sorted(predicted, key=lambda c: c.predicted["step_s"])
         survivors: List[Candidate] = []
+        # prefetch_depth changes the host pipeline, not the compiled
+        # program — a depth twin of an already-selected survivor shares
+        # that survivor's stage-2 measurement instead of burning a scarce
+        # top-k probe slot on a bit-identical program.
+        probe_sharers: List[Tuple[Candidate, Candidate]] = []
+        probed_programs: Dict[Tuple, Candidate] = {}
         for c in ranked:
             if c.asynchronous:
                 c.pruned = ("skipped: async candidate — predicted only, "
@@ -701,12 +754,21 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                 c.pruned = (f"predicted {c.predicted['step_s'] * 1e3:.3f} "
                             f"ms/step, > {1.0 + margin:.2f}x the frontrunner"
                             f" ({best_pred * 1e3:.3f} ms)")
-            elif len(survivors) >= top_k:
-                c.pruned = f"beyond top-k={top_k}"
             else:
-                survivors.append(c)
+                program = (c.base_key(), c.unroll, c.overlap)
+                twin = probed_programs.get(program)
+                if twin is not None:
+                    probe_sharers.append((c, twin))
+                elif len(survivors) >= top_k:
+                    c.pruned = f"beyond top-k={top_k}"
+                else:
+                    survivors.append(c)
+                    probed_programs[program] = c
         telemetry.gauge("tune.candidates").set(len(cands))
-        telemetry.gauge("tune.pruned").set(len(cands) - len(survivors))
+        # Gauges must reconcile: candidates = pruned + measured-directly
+        # (survivors) + measured-via-twin (probe sharers).
+        telemetry.gauge("tune.pruned").set(
+            len(cands) - len(survivors) - len(probe_sharers))
 
         # ---- stage 2: measure the survivors with real steps
         for c in survivors:
@@ -719,19 +781,35 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                     accumulation_steps=c.accumulation_steps,
                     unroll=c.unroll, zero=c.zero)
         telemetry.gauge("tune.probed").set(len(survivors))
-        measured = [c for c in survivors
+        for c, twin in probe_sharers:
+            c.probe = twin.probe   # same compiled program, one measurement
+        measured = [c for c in survivors + [s for s, _ in probe_sharers]
                     if c.probe is not None
                     and c.probe.steps_per_sec is not None]
         if not measured:
             raise RuntimeError(
                 "autotune: every stage-2 probe failed or was skipped:\n" +
                 "\n".join(f"  {c.name}: {c.probe.error}" for c in survivors))
-        winner = max(measured, key=lambda c: c.probe.steps_per_sec)
+
+        def effective_steps_per_s(c: Candidate) -> float:
+            # The probe loop feeds a resident synthetic batch — it measures
+            # the PROGRAM, not the loader — so a declared loader cost is
+            # added back as the candidate's priced residual data wait
+            # (max(0, loader_s - hidden_s), 0 for depth >= 1 pipelines that
+            # hide it). Without this, prefetch-depth twins would tie on
+            # measurement and load noise would pick the knob.
+            sps = c.probe.steps_per_sec
+            data_s = (((c.predicted or {}).get("breakdown") or {})
+                      .get("data_wait_s") or 0.0)
+            return 1.0 / (1.0 / sps + data_s) if data_s > 0 else sps
+
+        winner = max(measured, key=effective_steps_per_s)
 
     plan = TunedPlan(
         builder_spec=winner.builder_spec, unroll=winner.unroll,
         accumulation_steps=winner.accumulation_steps, zero=winner.zero,
-        overlap=winner.overlap, predicted=winner.predicted,
+        overlap=winner.overlap, prefetch_depth=winner.prefetch_depth,
+        predicted=winner.predicted,
         measured_steps_per_s=winner.probe.steps_per_sec, cache_key=key,
         search_s=time.perf_counter() - t_start, enumerated=len(cands),
         probed=len(survivors), candidates=cands)

@@ -198,12 +198,18 @@ class AlertRule:
             if not step_s:
                 return None
             # The plan's predicted per-step breakdown as attribution shares:
-            # phases the model does not price (data_wait, readback) are
-            # predicted 0 — exactly the bound drift is measured against.
+            # phases the model does not price (readback) are predicted 0 —
+            # exactly the bound drift is measured against. data_wait maps
+            # to the cost model's residual-loader term (max(0, loader_s -
+            # hidden_s)): a plan that priced a slow loader as hidden
+            # behind prefetch_depth predicts ~0 and the drift rule pages
+            # the moment the pipeline stops hiding it.
             phase = self.metric.rsplit(".", 1)[-1]
             share = {"compute": breakdown.get("compute_s", 0.0),
                      "comm": breakdown.get("comm_s", 0.0),
-                     "host": breakdown.get("host_s", 0.0)}.get(phase, 0.0)
+                     "host": breakdown.get("host_s", 0.0),
+                     "data_wait": breakdown.get("data_wait_s", 0.0)
+                     }.get(phase, 0.0)
             return float(share) / float(step_s) if share else 0.0
         if self.ref_from == "window_max":
             series = [v for _, v in history.series(self.metric,

@@ -98,7 +98,9 @@ def calibrate(profile: Dict[str, Any]) -> Calibration:
 
 def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
             calib: Calibration,
-            comm_bytes_per_step: float = 0.0) -> Dict[str, Any]:
+            comm_bytes_per_step: float = 0.0,
+            loader_s_per_step: float = 0.0,
+            prefetch_depth: int = 0) -> Dict[str, Any]:
     """Predict per-step time for a candidate plan's program set.
 
     ``plan_costs``: one program-cost dict or an iterable of them — the
@@ -111,9 +113,18 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
     ``calib.host_s_per_dispatch`` for the launch; ``comm_bytes_per_step``
     over the calibrated wire bandwidth adds the PS transfer term.
 
+    ``loader_s_per_step`` prices the input pipeline: with
+    ``prefetch_depth == 0`` (the synchronous feed) the loader's full
+    per-step seconds land in the step; with ``prefetch_depth >= 1`` the
+    async producer overlaps loading with the rest of the step, so only
+    the RESIDUAL ``max(0, loader_s - hidden_s)`` remains, where
+    ``hidden_s`` is everything the pipeline can hide behind (device +
+    host + comm per step) — the steady-state bound: a pipeline of any
+    depth >= 1 sustains ``max(rest_s, loader_s)`` per step.
+
     Returns ``{"step_s", "steps_per_s", "bound", "breakdown": {compute_s,
-    memory_s, host_s, comm_s per step}}`` — ``bound`` names the binding
-    resource, the MLPerf-style "what do I fix first" answer."""
+    memory_s, host_s, comm_s, data_wait_s per step}}`` — ``bound`` names
+    the binding resource, the MLPerf-style "what do I fix first" answer."""
     if isinstance(plan_costs, dict):
         plan_costs = [plan_costs]
     compute_s = memory_s = device_s = 0.0
@@ -135,15 +146,22 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
     comm_s = 0.0
     if comm_bytes_per_step and calib.wire_bytes_per_s:
         comm_s = comm_bytes_per_step / calib.wire_bytes_per_s
-    step_s = device_s / total_steps + host_s / total_steps + comm_s
+    hidden_s = device_s / total_steps + host_s / total_steps + comm_s
+    data_s = 0.0
+    if loader_s_per_step > 0:
+        data_s = max(0.0, loader_s_per_step - hidden_s) \
+            if prefetch_depth >= 1 else float(loader_s_per_step)
+    step_s = hidden_s + data_s
     breakdown = {"compute_s": compute_s / total_steps,
                  "memory_s": memory_s / total_steps,
                  "host_s": host_s / total_steps,
-                 "comm_s": comm_s}
+                 "comm_s": comm_s,
+                 "data_wait_s": data_s}
     bound = max(("compute", breakdown["compute_s"]),
                 ("memory", breakdown["memory_s"]),
                 ("host", breakdown["host_s"]),
                 ("comm", breakdown["comm_s"]),
+                ("data_wait", breakdown["data_wait_s"]),
                 key=lambda kv: kv[1])[0] if step_s > 0 else "unknown"
     return {"step_s": step_s,
             "steps_per_s": (1.0 / step_s) if step_s > 0 else None,

@@ -15,7 +15,8 @@ import numpy as np
 
 from autodist_tpu import const, telemetry
 from autodist_tpu.checkpoint.saver import Saver
-from autodist_tpu.runner import TrainState
+from autodist_tpu.data import prefetch as _prefetch
+from autodist_tpu.runner import MicroBatched, TrainState
 from autodist_tpu.telemetry import health as _health
 from autodist_tpu.telemetry import history as _history
 from autodist_tpu.telemetry import openmetrics as _openmetrics
@@ -45,12 +46,22 @@ def _make_meter(first_batch: PyTree, batch_size: Optional[int],
                 log_every: int) -> ThroughputMeter:
     """Meter sized lazily from the first batch: the largest leading dim fixes
     the example count per step (shared by the per-step and unrolled loops so
-    their examples/s can never diverge for identical configs)."""
+    their examples/s can never diverge for identical configs). A batch that
+    already went through ``shard_batch`` under gradient accumulation carries
+    ``MicroBatched`` leaves laid out ``[k, B/k, ...]`` — fold those back to
+    ``B`` (the prefetched per-step loop meters the transformed batch)."""
     n = batch_size
     if n is None:
-        leaves = [l for l in jax.tree_util.tree_leaves(first_batch)
-                  if getattr(l, "ndim", 0) >= 1]
-        n = max((l.shape[0] for l in leaves), default=1)
+        dims = []
+        for leaf in jax.tree_util.tree_leaves(
+                first_batch, is_leaf=lambda x: isinstance(x, MicroBatched)):
+            if isinstance(leaf, MicroBatched):
+                v = leaf.value
+                if getattr(v, "ndim", 0) >= 2:
+                    dims.append(v.shape[0] * v.shape[1])
+            elif getattr(leaf, "ndim", 0) >= 1:
+                dims.append(leaf.shape[0])
+        n = max(dims, default=1)
     return ThroughputMeter(batch_size=n, log_every=log_every, log=False)
 
 
@@ -72,6 +83,7 @@ def train(runner, params: PyTree,
           eval_fn: Optional[Callable] = None,
           on_eval: Optional[Callable[[int, Any], None]] = None,
           unroll: Optional[int] = None,
+          prefetch_depth: Optional[int] = None,
           health_monitor: Optional["_health.HealthMonitor"] = None) -> TrainState:
     """Run ``steps`` global steps, checkpointing and resuming automatically.
 
@@ -110,6 +122,21 @@ def train(runner, params: PyTree,
     Runners without fused support (async-PS, remote workers) fall back to the
     per-step loop with a warning.
 
+    ``prefetch_depth`` arms the async input pipeline
+    (:mod:`autodist_tpu.data.prefetch`): a background producer pulls up to
+    ``prefetch_depth`` batches (blocks, under ``unroll=K``) ahead of the
+    step and applies the feed remapping (``shard_batch``/``shard_block``)
+    there, so host loading and host->HBM transfer overlap the running
+    step; ``train.data_wait`` then measures only the residual queue wait,
+    while the ``data.producer_wait`` counter keeps naming a slow loader.
+    ``None`` adopts the tuned plan's ``prefetch_depth`` when one is
+    attached and nonzero, else the ``AUTODIST_PREFETCH_DEPTH`` flag
+    (default 0 = the synchronous feed, batches pulled exactly at their
+    step). Prefetching calls the batch source up to ``prefetch_depth``
+    items ahead (an iterable may be advanced past the last consumed step
+    at shutdown); exceptions from the source re-raise at the consuming
+    step, and exhaustion ends the run exactly like the synchronous path.
+
     ``health_monitor`` overrides the ``AUTODIST_HEALTH`` default (a
     :class:`telemetry.HealthMonitor`, or the flag builds one): the monitor
     consumes each log period's per-step losses plus the runner's fused
@@ -129,6 +156,17 @@ def train(runner, params: PyTree,
                          getattr(tuned, "name", "tuned plan"))
     if unroll < 1:
         raise ValueError("unroll must be >= 1")
+    if prefetch_depth is None:
+        tuned = getattr(runner, "tuned_plan", None)
+        tuned_depth = int(getattr(tuned, "prefetch_depth", 0) or 0)
+        if tuned_depth > 0:
+            logging.info("train: adopting tuned plan prefetch_depth=%d "
+                         "(pass prefetch_depth= explicitly to override)",
+                         tuned_depth)
+            prefetch_depth = tuned_depth
+        else:
+            prefetch_depth = _prefetch.default_prefetch_depth()
+    prefetch_depth = max(0, int(prefetch_depth))
     if eval_every and eval_batch is None:
         raise ValueError("eval_every needs an eval_batch")
     if is_chief is None:
@@ -223,12 +261,77 @@ def train(runner, params: PyTree,
         return final_state
 
     if use_blocks:
-        return _finish(_unrolled_loop(
-            runner, state, next_batch, batch_iter, start, steps, unroll,
+        # Async input pipeline for the fused loop: the producer gathers the
+        # NEXT blocks (clipped at the same cadence boundaries the sync path
+        # uses) and pre-shards them (shard_block = stacking + async
+        # device_put) up to prefetch_depth blocks ahead, so the BatchBlock
+        # queue feeds without blocking at block assembly.
+        feed = None
+        if prefetch_depth > 0:
+            feed = _BlockFeed(
+                runner, next_batch, batch_iter, start, steps, unroll,
+                _boundary_fn(steps, save_every if saver is not None else 0,
+                             eval_every), prefetch_depth)
+        try:
+            return _finish(_unrolled_loop(
+                runner, state, next_batch, batch_iter, start, steps, unroll,
+                saver, prefix_base, save_participant, save_every, async_save,
+                log_every, batch_size, on_metrics, eval_every, eval_batch,
+                eval_fn, on_eval, monitor, feed))
+        finally:
+            if feed is not None:
+                feed.close()
+
+    # Async input pipeline: with prefetch_depth > 0 a background producer
+    # pulls host batches AND applies the feed remapping (shard_batch =
+    # async device_put) up to `depth` ahead, so the loop's train.data_wait
+    # span measures only the residual queue wait. The producer books
+    # data.producer_wait/queue_depth, keeping a slow loader visible.
+    feed = _step_feed(runner, next_batch, batch_iter, start, steps,
+                      prefetch_depth) if prefetch_depth > 0 else None
+    try:
+        state = _per_step_loop(
+            runner, state, feed, next_batch, batch_iter, start, steps,
             saver, prefix_base, save_participant, save_every, async_save,
             log_every, batch_size, on_metrics, eval_every, eval_batch,
-            eval_fn, on_eval, monitor))
+            eval_fn, on_eval, monitor)
+    finally:
+        if feed is not None:
+            feed.close()
+    return _finish(state)
 
+
+def _step_feed(runner, next_batch, batch_iter, start: int, steps: int,
+               depth: int, workers: Optional[int] = None):
+    """The per-step loop's async feed: a :class:`PrefetchProducer` pulling
+    the batch source in step order and applying ``runner.shard_batch``
+    (when the runner has one — async/remote regimes prefetch host batches
+    only) on the producer side. Pulls stop at ``steps``: a callable
+    source is never invoked past the last step it could train (readahead
+    must not call user code out of the run's contract)."""
+    if next_batch is not None:
+        counter = iter(range(start, steps))
+        pull = lambda: next_batch(next(counter))  # noqa: E731
+    else:
+        pull = lambda: next(batch_iter)           # noqa: E731
+    shard = getattr(runner, "shard_batch", None)
+    transform = shard if (callable(shard)
+                          and not getattr(runner, "_is_remote_worker",
+                                          False)) else None
+    return _prefetch.PrefetchProducer(pull, transform, depth=depth,
+                                      workers=workers
+                                      or _prefetch.default_prefetch_workers(),
+                                      name="train-feed")
+
+
+def _per_step_loop(runner, state: TrainState, feed, next_batch, batch_iter,
+                   start: int, steps: int, saver, prefix_base,
+                   save_participant, save_every: int, async_save: bool,
+                   log_every: int, batch_size: Optional[int], on_metrics,
+                   eval_every: int, eval_batch, eval_fn, on_eval,
+                   monitor) -> TrainState:
+    """The classic one-dispatch-per-step loop (``unroll=1``), fed either
+    synchronously or from the async prefetch producer (``feed``)."""
     meter = None
     loss = None
     # Health monitoring: per-step device losses accumulate here (tiny device
@@ -237,7 +340,15 @@ def train(runner, params: PyTree,
     # only once per period.
     pending_losses = []
     for step_i in range(start, steps):
-        if next_batch is not None:
+        if feed is not None:
+            try:
+                with telemetry.span("train.data_wait"):
+                    batch = next(feed)
+            except StopIteration:
+                logging.info("train: batch iterator exhausted at step %d",
+                             step_i)
+                break
+        elif next_batch is not None:
             with telemetry.span("train.data_wait"):
                 batch = next_batch(step_i)
         else:
@@ -269,16 +380,19 @@ def train(runner, params: PyTree,
                     if _profiling.active() else None
                 # Async-PS runs append their transport accounting (zero-copy
                 # wire counters) so per-period logs show parameter/gradient
-                # traffic next to throughput. `q` is the dispatch-ahead queue
-                # depth (always 0 in the per-step loop), `rb` the seconds this
-                # period spent blocked on device->host readback — together
-                # they say whether a slow period was compute, readback, or
-                # host-side stall, from the log line alone.
+                # traffic next to throughput. `q` is the input queue depth
+                # (the prefetch producer's fill with prefetch_depth > 0,
+                # else 0 — 0 under prefetch means the loader is not keeping
+                # up), `rb` the seconds this period spent blocked on
+                # device->host readback — together they say whether a slow
+                # period was compute, readback, or host-side stall, from
+                # the log line alone.
                 stats = getattr(runner, "wire_stats", None)
                 stats = stats() if callable(stats) else None
                 logging.info("train: step %d loss %.4f %.1f examples/s "
-                             "| q 0 rb %.3fs%s%s",
+                             "| q %d rb %.3fs%s%s",
                              step_i + 1, float(loss), rate,
+                             feed.queue_depth() if feed is not None else 0,
                              meter.last_readback_s,
                              f" | {stats.format_line()}" if stats else "",
                              _profiling.format_attr_line(attr))
@@ -340,7 +454,87 @@ def train(runner, params: PyTree,
                         jax.device_get(pending_losses), state)
     if meter is not None:
         meter.finish()   # freeze the run clock: average stays the TRAIN rate
-    return _finish(state)
+    return state
+
+
+def _boundary_fn(steps: int, save_every: int, eval_every: int):
+    """``next_boundary(i)``: the first step index after ``i`` where a block
+    must END (a ``save_every``/``eval_every`` multiple, or ``steps``) — ONE
+    clipping rule, shared by the sync gather and the async block feed so
+    their block shapes can never diverge."""
+    boundaries = [p for p in (save_every, eval_every) if p]
+
+    def next_boundary(i: int) -> int:
+        nxt = steps
+        for p in boundaries:
+            nxt = min(nxt, (i // p + 1) * p)
+        return nxt
+
+    return next_boundary
+
+
+class _BlockFeed:
+    """The unrolled loop's async block source: a :class:`PrefetchProducer`
+    whose pulls gather cadence-clipped host blocks (the sync ``gather``'s
+    exact clipping, via the shared boundary fn) and whose transform is
+    ``runner.shard_block`` — so block assembly AND host->HBM transfer run
+    ``depth`` blocks ahead of the device. A source that exhausts mid-block
+    still emits the partial block (the sync path's contract: those steps
+    were consumed and must train)."""
+
+    def __init__(self, runner, next_batch, batch_iter, start: int,
+                 steps: int, unroll: int, next_boundary, depth: int,
+                 workers: Optional[int] = None):
+        self.first_batch = None   # meter sizing; set before the first emit
+        self._next_batch = next_batch
+        self._batch_iter = batch_iter
+        self._cursor = start
+        self._steps = steps
+        self._unroll = unroll
+        self._next_boundary = next_boundary
+        self._exhausted = False
+        self._producer = _prefetch.PrefetchProducer(
+            self._pull, runner.shard_block, depth=depth,
+            workers=workers or _prefetch.default_prefetch_workers(),
+            name="train-feed")
+
+    def _pull(self):
+        i = self._cursor
+        if self._exhausted or i >= self._steps:
+            raise StopIteration
+        blk = []
+        for j in range(min(self._unroll, self._next_boundary(i) - i)):
+            if self._next_batch is not None:
+                blk.append(self._next_batch(i + j))
+            else:
+                try:
+                    blk.append(next(self._batch_iter))
+                except StopIteration:
+                    self._exhausted = True
+                    logging.info("train: batch iterator exhausted at "
+                                 "step %d", i + len(blk))
+                    break
+        if not blk:
+            raise StopIteration
+        if self.first_batch is None:
+            self.first_batch = blk[0]
+        self._cursor = i + len(blk)
+        return blk
+
+    def next_block(self):
+        """The next pre-sharded BatchBlock, or None at the end of the run
+        (exhaustion / ``steps`` reached) — the sync ``gather``'s return
+        contract."""
+        try:
+            return next(self._producer)
+        except StopIteration:
+            return None
+
+    def queue_depth(self) -> int:
+        return self._producer.queue_depth()
+
+    def close(self):
+        self._producer.close()
 
 
 def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
@@ -348,7 +542,8 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                    saver, prefix_base, save_participant, save_every: int,
                    async_save: bool, log_every: int, batch_size: Optional[int],
                    on_metrics, eval_every: int, eval_batch, eval_fn,
-                   on_eval, monitor=None) -> TrainState:
+                   on_eval, monitor=None, feed: Optional[_BlockFeed] = None
+                   ) -> TrainState:
     """The fused dispatch-ahead pipeline behind ``train(..., unroll=K)``.
 
     Consecutive batches are gathered into blocks of up to ``unroll`` steps and
@@ -359,23 +554,30 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
     at every ``save_every``/``eval_every`` multiple and at ``steps``, which
     keeps checkpoint/eval/resume semantics identical to the per-step loop;
     losses are read back (``jax.device_get``) only when a ``log_every``
-    period closes at a block boundary."""
-    boundaries = [p for p in (save_every if saver is not None else 0,
-                              eval_every) if p]
+    period closes at a block boundary.
 
-    def next_boundary(i: int) -> int:
-        nxt = steps
-        for p in boundaries:
-            nxt = min(nxt, (i // p + 1) * p)
-        return nxt
-
+    With ``feed`` (a :class:`_BlockFeed`, ``train(prefetch_depth>0)``) the
+    blocks arrive pre-sharded from the async producer instead of being
+    gathered here: ``train.data_wait`` then measures only the residual
+    queue wait, and the producer's ``data.*`` telemetry carries the loader
+    cost."""
+    next_boundary = _boundary_fn(steps,
+                                 save_every if saver is not None else 0,
+                                 eval_every)
     exhausted = False
     first_batch = None
 
     def gather(i: int):
         """Up to min(unroll, steps-to-next-cadence-point) host batches
-        starting at step index ``i``; None when the run is over."""
+        starting at step index ``i``, pre-sharded; None when the run is
+        over."""
         nonlocal exhausted, first_batch
+        if feed is not None:
+            with telemetry.span("train.data_wait"):
+                block = feed.next_block()
+            if first_batch is None:
+                first_batch = feed.first_batch
+            return block
         if exhausted or i >= steps:
             return None
         blk = []
@@ -415,7 +617,8 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
         # enqueued; gather + pre-shard the next block NOW, before any sync
         # below, so host batch assembly and h->d transfer overlap the device.
         next_block = gather(step_i)
-        queue_depth = 1 if next_block is not None else 0
+        queue_depth = (1 if next_block is not None else 0) \
+            + (feed.queue_depth() if feed is not None else 0)
         if telemetry.enabled():
             telemetry.gauge("train.dispatch_queue_depth").set(queue_depth)
         if meter is None and log_every:

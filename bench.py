@@ -1292,6 +1292,165 @@ def autotune_bench(rounds: int = 3, steps: int = 48):
     return result
 
 
+def data_plane_bench(steps: int = 96, log_every: int = 32, rounds: int = 3,
+                     sleep_ms: float = 4.0, depth: int = 4):
+    """Input-data plane gate: an injected slow host loader (a fixed
+    per-batch sleep — the MLPerf pod bottleneck in miniature) fed to
+    ``train()`` synchronously (``prefetch_depth=0``) vs through the async
+    prefetch producer (``prefetch_depth=depth``), best of ``rounds``
+    interleaved rounds. Gated numbers in the PERF_BASELINE.json
+    ``data_plane`` row:
+
+    - ``prefetch_vs_sync`` >= ``min_ratio`` (1.2): the producer must
+      actually hide the injected stall behind the running step;
+    - the prefetched leg's ``train.attr.data_wait`` share must sit BELOW
+      ``max_data_wait_share`` — the shipped ``data_wait_drift`` alert's
+      band, so the rule that pages on a sync slow loader stays quiet on
+      the prefetched one;
+    - ``data.producer_wait`` must still carry >= half the injected loader
+      seconds: hiding the stall must not hide the SLOW LOADER (the
+      counter is how attribution keeps naming it);
+    - the two legs' final params must be BIT-IDENTICAL (prefetching
+      reorders nothing — same batches, same math, same order)."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry, training
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry import alerts, profiling
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    uniques = [transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                              seq_len=seq_len, seed=s)
+               for s in range(4)]
+    sleep_s = sleep_ms / 1e3
+
+    def slow_batches(i):
+        time.sleep(sleep_s)      # the injected loader stall
+        return uniques[i % len(uniques)]
+
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(loss_fn, params, optax.adam(1e-3),
+                                           example_batch=uniques[0])
+
+    was_enabled = telemetry.enabled()
+    profiling.enable()    # attribution on: the gate reads data_wait shares
+
+    def leg(depth_):
+        """One timed train() run from the same start params; returns
+        (steps/s, period-weighted data_wait share, producer_wait delta,
+        final params)."""
+        profiling.reset()
+        wait0 = telemetry.counter("data.producer_wait").value
+        t0 = time.perf_counter()
+        final = training.train(runner, params, slow_batches, steps,
+                               log_every=log_every, prefetch_depth=depth_)
+        dt = time.perf_counter() - t0
+        periods = profiling.attribution_periods()
+        total_s = sum(p["period_s"] for p in periods)
+        share = (sum(p["shares"]["data_wait"] * p["period_s"]
+                     for p in periods) / total_s) if total_s else None
+        wait_s = telemetry.counter("data.producer_wait").value - wait0
+        return steps / dt, share, wait_s, jax.device_get(
+            runner.logical_params(final))
+
+    leg(0)   # compile + warmup (both loops share the compiled step)
+    best = {"sync": 0.0, "prefetched": 0.0}
+    sync_share = pf_share = None
+    producer_wait_s = 0.0
+    params_sync = params_pf = None
+    for _ in range(rounds):   # interleaved: load noise hits both sides
+        rate, share, _, params_sync = leg(0)
+        if rate > best["sync"]:
+            best["sync"], sync_share = rate, share
+        rate, share, wait_s, params_pf = leg(depth)
+        if rate > best["prefetched"]:
+            best["prefetched"], pf_share = rate, share
+            producer_wait_s = wait_s
+    profiling.reset()
+    profiling.disable()
+    telemetry.clear()
+    if was_enabled:
+        telemetry.enable()
+    else:
+        telemetry.disable()
+
+    flat_a = jax.tree_util.tree_leaves(params_sync)
+    flat_b = jax.tree_util.tree_leaves(params_pf)
+    bit_identical = len(flat_a) == len(flat_b) and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(flat_a, flat_b))
+    band = next(r["band"] for r in alerts.DEFAULT_RULES
+                if r["name"] == "data_wait_drift")
+    ratio = best["prefetched"] / best["sync"] if best["sync"] else 0.0
+    injected_s = steps * sleep_s
+
+    result = {
+        "metric": f"data_plane ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size}, "
+                  f"loader sleep {sleep_ms:g}ms, depth {depth})",
+        "unit": "steps/s",
+        "rows": {"sync": round(best["sync"], 2),
+                 "prefetched": round(best["prefetched"], 2)},
+        "prefetch_vs_sync": round(ratio, 4),
+        "data_wait_share": {"sync": round(sync_share, 4)
+                            if sync_share is not None else None,
+                            "prefetched": round(pf_share, 4)
+                            if pf_share is not None else None},
+        "drift_band": band,
+        "producer_wait_s": round(producer_wait_s, 3),
+        "injected_loader_s": round(injected_s, 3),
+        "bit_identical": bit_identical,
+    }
+    if not bit_identical:
+        print("WARNING: prefetched final params are NOT bit-identical to "
+              "the synchronous path's — the producer reordered or altered "
+              "batches (see data/prefetch.py ordering contract)",
+              file=sys.stderr)
+    if pf_share is not None and pf_share >= band:
+        print(f"WARNING: prefetched data_wait share {pf_share:.3f} is not "
+              f"below the data_wait_drift band ({band}) — the shipped "
+              f"alert would still page under prefetch", file=sys.stderr)
+    if producer_wait_s < 0.5 * injected_s:
+        print(f"WARNING: data.producer_wait booked {producer_wait_s:.2f}s "
+              f"of the {injected_s:.2f}s injected loader stall — the slow "
+              f"loader is no longer visible in producer telemetry",
+              file=sys.stderr)
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("data_plane")
+        if recorded and recorded.get("platform") == platform:
+            floor = recorded.get("min_ratio", 1.2)
+            if ratio < floor:
+                print(f"WARNING: prefetched path is {ratio:.2f}x the sync "
+                      f"steps/s under the injected slow loader, below the "
+                      f"{floor:.2f}x floor — the producer stopped hiding "
+                      f"the stall (see PERF_BASELINE.json data_plane)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["prefetched"],
+                        "unit": "steps/s",
+                        "prefetch_vs_sync": result["prefetch_vs_sync"],
+                        "data_wait_share": result["data_wait_share"],
+                        "producer_wait_s": result["producer_wait_s"]})
+    return result
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -1363,6 +1522,15 @@ def main(argv=None):
              "serving row in PERF_BASELINE.json (continuous must beat static "
              "on requests/s at equal-or-better p99)")
     parser.add_argument(
+        "--data-plane", action="store_true",
+        help="measure the input-data plane: train() under an injected slow "
+             "host loader (fixed per-batch sleep), synchronous feed vs the "
+             "async prefetch producer, gated against the data_plane row in "
+             "PERF_BASELINE.json (prefetched >= min_ratio x sync steps/s, "
+             "data_wait share below the data_wait_drift band, "
+             "data.producer_wait still naming the loader, bit-identical "
+             "params)")
+    parser.add_argument(
         "--autotune", action="store_true",
         help="run the plan autotuner's full predict-prune-probe search on "
              "the CPU micro-model and gate the winner: tuned plan steps/s "
@@ -1398,6 +1566,9 @@ def main(argv=None):
         return
     if args.serve:
         serve_bench()
+        return
+    if args.data_plane:
+        data_plane_bench()
         return
     if args.autotune:
         autotune_bench()

@@ -121,6 +121,12 @@ python bench.py --metrics-overhead
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead
+# Input-data plane gate: under an injected slow host loader the async
+# prefetch producer must beat the synchronous feed by min_ratio steps/s,
+# keep the data_wait share below the data_wait_drift band, keep naming
+# the slow loader via data.producer_wait, and stay bit-identical
+# (data_plane row).
+python bench.py --data-plane
 # Plan-autotuner gate: the predict-prune-probe search must measure at most
 # top-k of the enumerated candidates and its winner must not lose to the
 # default plan (autotune row: tuned/default >= min_ratio).

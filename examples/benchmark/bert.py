@@ -160,6 +160,7 @@ def main(argv=None):
 
     step = ad.function(loss_fn, params, optax.adamw(1e-4), example_batch=batch,
                        accumulation_steps=args.accum)
+    feed = None
     if args.data_dir:
         # Masked batches stream from disk through the prefetch ring; the
         # host->HBM transfer overlaps the running step (device_prefetch).
@@ -183,6 +184,8 @@ def main(argv=None):
         # below triggers its own lowering/compile work.
         avg = meter.average or 0.0
     finally:
+        if feed is not None:
+            feed.close()   # stop the producer before its loader goes away
         if loader is not None:
             loader.close()
     src = "disk" if args.data_dir else "synthetic"

@@ -96,9 +96,12 @@ def main(argv=None):
     loader = DataLoader(files={"tokens": shards}, batch_size=batch_size,
                         shuffle=True, prefetch=4)
     feed = device_prefetch(loader, step.runner, depth=2)
-    rate_disk = timed(lambda: next(feed), "disk-fed (mmap shards)")
-    native = loader.is_native
-    loader.close()
+    try:
+        rate_disk = timed(lambda: next(feed), "disk-fed (mmap shards)")
+        native = loader.is_native
+    finally:
+        feed.close()     # stop the producer before its loader goes away
+        loader.close()
 
     print(json.dumps({
         "resident_tokens_per_sec": round(rate_resident),

@@ -293,6 +293,7 @@ def main(argv=None):
     # dtype); the benchmark wants steady-state throughput with finite loss.
     step = ad.function(loss_fn, params, optax.sgd(0.01, momentum=0.9),
                        example_batch=batch, accumulation_steps=args.accum)
+    feed = None
     if cache is not None:
         next_batch = lambda: cache.next_batch(batch_size)  # noqa: E731
     elif batcher is not None:
@@ -331,6 +332,8 @@ def main(argv=None):
         bench_logger.on_finish(status="failure")
         raise
     finally:
+        if feed is not None:
+            feed.close()   # stop the producer before its loader goes away
         if loader is not None:
             loader.close()
     bench_logger.on_finish()

@@ -91,9 +91,13 @@ def main(argv=None):
 
     meter = ThroughputMeter(batch_size=batch_size, log_every=args.log_every)
     loss = None
-    for _ in range(args.steps):
-        loss = step(next(feed) if feed is not None else batch)
-        meter.step(sync=loss)
+    try:
+        for _ in range(args.steps):
+            loss = step(next(feed) if feed is not None else batch)
+            meter.step(sync=loss)
+    finally:
+        if feed is not None:
+            feed.close()  # stop the producer (it would keep building epochs)
     print(f"ncf: final loss {float(loss):.4f}, {meter.average or 0:.1f} examples/sec")
     if data is not None:
         from autodist_tpu.data.movielens import (hit_rate_and_ndcg,

@@ -64,13 +64,16 @@ def main(epochs: int = 5, batch_size: int = 64):
     feed = device_prefetch(loader, step.runner, depth=2)
     steps_per_epoch = len(images) // batch_size
     losses = []
-    for epoch in range(epochs):
-        for _ in range(steps_per_epoch):
-            loss = step(next(feed))
-        losses.append(float(loss))
-        print(f"epoch {epoch}: loss={losses[-1]:.4f} "
-              f"(loader={'native' if loader.is_native else 'numpy'})")
-    loader.close()
+    try:
+        for epoch in range(epochs):
+            for _ in range(steps_per_epoch):
+                loss = step(next(feed))
+            losses.append(float(loss))
+            print(f"epoch {epoch}: loss={losses[-1]:.4f} "
+                  f"(loader={'native' if loader.is_native else 'numpy'})")
+    finally:
+        feed.close()     # stop the producer before its loader goes away
+        loader.close()
     assert losses[-1] < losses[0]
     return losses
 

@@ -194,9 +194,13 @@ def main(argv=None):
     meter = ThroughputMeter(batch_size=args.batch_size * args.seq_len,
                             log_every=args.log_every, unit="words")
     loss = None
-    for i in range(args.steps):
-        loss = step(next(feed) if feed is not None else batch)
-        meter.step(sync=loss)
+    try:
+        for i in range(args.steps):
+            loss = step(next(feed) if feed is not None else batch)
+            meter.step(sync=loss)
+    finally:
+        if feed is not None:
+            feed.close()   # stop the producer before its loader goes away
     print(f"final loss {float(loss):.4f}; average {meter.average or 0:.1f} words/sec")
     if not getattr(args, "full_softmax", False):
         # XLA cost analysis of the compiled step (skipped for --full_softmax,

@@ -194,6 +194,7 @@ def test_device_prefetch_feeds_training():
     feed = device_prefetch(dl, step.runner, depth=2)
     losses = [float(step(next(feed))) for _ in range(20)]
     assert losses[-1] < 0.1 * losses[0]
+    feed.close()   # stop the producer before its loader goes away
     dl.close()
 
 

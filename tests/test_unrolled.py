@@ -200,6 +200,7 @@ def test_device_prefetch_unroll_yields_blocks():
         assert isinstance(block, BatchBlock) and len(block) == 2
         state, losses = runner.run_many(state, block)
         assert losses.shape == (2,)
+        it.close()   # stop the producer before its loader goes away
     finally:
         loader.close()
 
