@@ -138,7 +138,32 @@ KNOWN_FLAGS = {
                             "setting it arms boundary sampling",
     "AUTODIST_ALERT_ACTION": "what a firing alert does: 'warn' (log), "
                              "'record' (flight-recorder snapshot), 'halt' "
-                             "(raise AlertHalt out of the sampling loop)",
+                             "(raise AlertHalt out of the sampling loop), "
+                             "'recover' (roll back to the last good snapshot "
+                             "and resume, bounded by AUTODIST_RECOVER_MAX)",
+    "AUTODIST_EVICT_AFTER_S": "auto-eviction: a worker the PS watchdog sees "
+                              "silent for this many seconds is retired from "
+                              "the staleness gate (its parked RPCs fail "
+                              "typed, live workers resume); 0/unset = "
+                              "detect-and-warn only",
+    "AUTODIST_WORKER_FAILURE": "coordinator policy for a nonzero worker "
+                               "exit: 'halt' (fail-fast chief kill, the "
+                               "reference behavior) or 'respawn' (relaunch "
+                               "with bounded exponential backoff, up to "
+                               "AUTODIST_RECOVER_MAX times per worker)",
+    "AUTODIST_RECOVER_MAX": "recovery attempt budget: rollback attempts "
+                            "under action=recover / respawns per worker "
+                            "before escalating to the existing halt",
+    "AUTODIST_WIRE_RETRIES": "PS transport retry budget: transient connect "
+                             "refusals/resets on IDEMPOTENT opcodes retry "
+                             "this many times with jittered exponential "
+                             "backoff before surfacing",
+    "AUTODIST_WIRE_BACKOFF_S": "base seconds of the wire retry backoff "
+                               "(doubles per attempt, jittered, capped)",
+    "AUTODIST_FAULTS": "deterministic fault-injection spec for the chaos "
+                       "tests/bench (testing/faults.py grammar: "
+                       "'worker_crash@step=3,worker=1;nan_grads@step=5'); "
+                       "empty = disarmed",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -279,6 +304,19 @@ _ENV_DEFAULTS = {
     "AUTODIST_METRICS_INTERVAL_S": 0.0,
     "AUTODIST_ALERT_RULES": "",
     "AUTODIST_ALERT_ACTION": "warn",
+    # Recovery plane (autodist_tpu/parallel/recovery.py): close the
+    # detect->act loop. EVICT_AFTER_S arms watchdog auto-eviction (0 = the
+    # previous warn-only behavior); WORKER_FAILURE picks the coordinator's
+    # reaction to a dead worker (the reference could only fail-fast);
+    # RECOVER_MAX bounds rollback/respawn attempts before escalating to
+    # halt; the WIRE pair tunes the transport's idempotent-op retry; FAULTS
+    # arms the deterministic chaos harness (testing/faults.py).
+    "AUTODIST_EVICT_AFTER_S": 0.0,
+    "AUTODIST_WORKER_FAILURE": "halt",
+    "AUTODIST_RECOVER_MAX": 3,
+    "AUTODIST_WIRE_RETRIES": 2,
+    "AUTODIST_WIRE_BACKOFF_S": 0.2,
+    "AUTODIST_FAULTS": "",
 }
 
 class ENV(enum.Enum):
@@ -335,6 +373,12 @@ class ENV(enum.Enum):
     AUTODIST_METRICS_INTERVAL_S = "AUTODIST_METRICS_INTERVAL_S"
     AUTODIST_ALERT_RULES = "AUTODIST_ALERT_RULES"
     AUTODIST_ALERT_ACTION = "AUTODIST_ALERT_ACTION"
+    AUTODIST_EVICT_AFTER_S = "AUTODIST_EVICT_AFTER_S"
+    AUTODIST_WORKER_FAILURE = "AUTODIST_WORKER_FAILURE"
+    AUTODIST_RECOVER_MAX = "AUTODIST_RECOVER_MAX"
+    AUTODIST_WIRE_RETRIES = "AUTODIST_WIRE_RETRIES"
+    AUTODIST_WIRE_BACKOFF_S = "AUTODIST_WIRE_BACKOFF_S"
+    AUTODIST_FAULTS = "AUTODIST_FAULTS"
 
     @property
     def val(self):

@@ -7,21 +7,34 @@ Parity with reference ``autodist/coordinator.py``:
   (``AUTODIST_WORKER=<ip>``, ``AUTODIST_STRATEGY_ID=<id>``, reference ``:66-90``),
   plus the TPU-native bootstrap env (coordinator address, process count/id) that
   ``jax.distributed.initialize`` consumes on each host.
-- A watchdog thread per remote process fail-fasts the chief on any nonzero worker
-  exit (``os._exit(1)``, reference ``:98-110``).
+- A watchdog thread per remote process reacts to any nonzero worker exit per
+  the ``AUTODIST_WORKER_FAILURE`` policy: ``halt`` fail-fasts the chief
+  (``os._exit(1)``, the reference's only behavior, ``:98-110``); ``respawn``
+  relaunches the worker with bounded exponential backoff — machine loss is
+  routine at pod scale, and a relaunched async-PS worker re-registers the
+  staleness gate and catches up on the chief's live params with no checkpoint
+  (``parallel/recovery.py``). Respawns are budgeted per worker
+  (``AUTODIST_RECOVER_MAX``); an exhausted budget escalates to ``halt``.
 """
 
 import os
 import sys
 import threading
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
 from autodist_tpu import const
 from autodist_tpu.cluster import Cluster, is_local_address
+from autodist_tpu.parallel import recovery as _recovery
 from autodist_tpu.utils import logging
 
 
 class Coordinator:
+    # Respawn backoff: base doubles per attempt (jittered), capped. Class
+    # attributes so tests (and future elastic policies) can tighten them.
+    RESPAWN_BACKOFF_S = 1.0
+    RESPAWN_BACKOFF_CAP_S = 30.0
+
     def __init__(self, strategy, cluster: Cluster,
                  argv: Optional[List[str]] = None):
         self._strategy = strategy
@@ -29,6 +42,9 @@ class Coordinator:
         self._argv = argv if argv is not None else sys.argv
         self._procs = []
         self._watchdogs: List[threading.Thread] = []
+        # Per-address relaunch spec (cmd + env + respawn attempt count) —
+        # what the respawn policy re-executes when a worker dies.
+        self._launch_specs: Dict[str, dict] = {}
 
     def launch_clients(self, extra_env: Optional[dict] = None):
         """Ship strategy + relaunch the user script on every non-chief host.
@@ -68,6 +84,8 @@ class Coordinator:
             if extra_env:
                 env.update({k: str(v) for k, v in extra_env.items()})
             cmd = [sys.executable] + self._argv
+            self._launch_specs[address] = {"cmd": cmd, "env": env,
+                                           "respawns": 0}
             logging.info("Launching worker on %s (process %d/%d)",
                          address, proc_info["process_id"], n)
             proc = self._cluster.remote_exec(cmd, address, env=env)
@@ -75,10 +93,66 @@ class Coordinator:
             self._watch(proc, address)
 
     def _on_worker_failure(self, address: str, code: int):
-        """Fail-fast: kill the chief (reference coordinator.py:98-110). Overridable
-        for tests and for future elastic policies."""
-        logging.error("Worker %s exited with code %s; terminating chief", address, code)
+        """React to a nonzero worker exit per ``AUTODIST_WORKER_FAILURE``:
+
+        - ``halt`` (default): kill the chief (reference coordinator.py:98-110).
+        - ``respawn``: relaunch the worker's exact command/env after a
+          bounded, jittered exponential backoff — an async-PS replacement
+          re-registers the gate and pulls the chief's live params on its own
+          (checkpoint-free restart). At most ``AUTODIST_RECOVER_MAX``
+          respawns per worker; exhaustion (or a worker never launched by
+          this coordinator) escalates to ``halt``.
+
+        Overridable for tests and custom elastic policies; runs on the dead
+        worker's daemon watchdog thread."""
+        policy = str(const.ENV.AUTODIST_WORKER_FAILURE.val)
+        if policy not in ("halt", "respawn"):
+            logging.warning("AUTODIST_WORKER_FAILURE=%r is not a policy "
+                            "(halt|respawn); treating as halt", policy)
+            policy = "halt"
+        if policy == "respawn":
+            # A failed relaunch (fork failure, vanished interpreter, ssh
+            # error) must ESCALATE to halt, not kill this daemon watchdog
+            # thread silently — a dead worker with no respawn AND no halt
+            # would park the surviving workers at the staleness bound
+            # forever, strictly worse than the fail-fast it replaced.
+            try:
+                if self._respawn(address, code):
+                    return
+            except Exception as e:
+                logging.error("Worker %s respawn failed (%s); escalating "
+                              "to halt", address, e)
+        logging.error("Worker %s exited with code %s; terminating chief",
+                      address, code)
         os._exit(1)
+
+    def _respawn(self, address: str, code: int) -> bool:
+        """One respawn attempt for ``address``; False when the budget is
+        spent or the address is unknown (caller escalates to halt)."""
+        spec = self._launch_specs.get(address)
+        budget = _recovery.recover_max()
+        if spec is None or spec["respawns"] >= budget:
+            if spec is not None:
+                logging.error(
+                    "Worker %s exited with code %s and its respawn budget "
+                    "(%d, AUTODIST_RECOVER_MAX) is spent; escalating to "
+                    "halt", address, code, budget)
+            return False
+        spec["respawns"] += 1
+        delay = _recovery.backoff_s(spec["respawns"] - 1,
+                                    self.RESPAWN_BACKOFF_S,
+                                    self.RESPAWN_BACKOFF_CAP_S)
+        logging.warning(
+            "Worker %s exited with code %s; respawning in %.1fs "
+            "(attempt %d/%d)", address, code, delay, spec["respawns"],
+            budget)
+        _recovery.log_respawn(address, spec["respawns"], delay)
+        time.sleep(delay)   # bounded: RESPAWN_BACKOFF_CAP_S
+        proc = self._cluster.remote_exec(spec["cmd"], address,
+                                         env=spec["env"])
+        self._procs.append(proc)
+        self._watch(proc, address)
+        return True
 
     def _watch(self, proc, address: str):
         def wait():

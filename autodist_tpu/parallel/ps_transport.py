@@ -58,6 +58,7 @@ import numpy as np
 
 from autodist_tpu import telemetry
 from autodist_tpu.parallel import wire
+from autodist_tpu.testing import faults as _faults
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import WireCounters
 
@@ -378,10 +379,19 @@ class _StragglerWatchdog:
     WARN_EVERY_S = 60.0
 
     def __init__(self, server: "PSServer", interval: float,
-                 warn_every: Optional[float] = None):
+                 warn_every: Optional[float] = None,
+                 evict_after: Optional[float] = None):
+        """``evict_after`` arms auto-eviction: a worker silent longer than
+        this many seconds is RETIRED from the staleness gate (the recovery
+        plane's close-the-loop action), not just flagged. Default: the
+        ``AUTODIST_EVICT_AFTER_S`` flag (0/unset = detect-and-warn only,
+        the pre-recovery behavior)."""
+        from autodist_tpu.parallel import recovery as _recovery
         self._server = server
         self._interval = max(0.01, float(interval))
         self._stall_after = self.STALL_INTERVALS * self._interval
+        self._evict_after = _recovery.evict_after_s() \
+            if evict_after is None else (float(evict_after) or None)
         self._warn_every = self.WARN_EVERY_S if warn_every is None \
             else float(warn_every)
         self._last_warn: dict = {}
@@ -453,6 +463,21 @@ class _StragglerWatchdog:
             # at the anomaly, debounced; un-armed it is a no-op.
             from autodist_tpu.telemetry import recorder as _recorder
             _recorder.maybe_record(f"ps.{kind}.w{wid}", server=self._server)
+            # Auto-eviction (AUTODIST_EVICT_AFTER_S): a sustained STALL past
+            # the policy threshold RETIRES the worker — live workers parked
+            # at the staleness bound resume instead of waiting forever, the
+            # evicted worker's parked gate RPC fails typed (WorkerEvicted),
+            # and its client rejoins on its own if it was merely slow. Once
+            # retired the worker leaves live_lags, so the eviction cannot
+            # re-fire on the next tick. STRAGGLER flags never evict: that
+            # worker is actively completing exchanges, just slowly —
+            # evicting it would churn evict/rejoin every long step and
+            # throw its compute away.
+            if (kind == "stall" and self._evict_after is not None
+                    and age > self._evict_after and controller is not None):
+                from autodist_tpu.parallel import recovery as _recovery
+                _recovery.evict(controller, wid, kind="stall", age_s=age,
+                                server=self._server)
             if now - self._last_warn.get(wid, -math.inf) >= self._warn_every:
                 self._last_warn[wid] = now
                 if kind == "stall":
@@ -607,8 +632,19 @@ class PSServer:
                         logging.warning(
                             "PS worker %s disconnected; retiring it from the "
                             "staleness gate", self.worker_id)
-                        controller.retire(self.worker_id,
-                                          generation=self.worker_gen)
+                        # Recovery bookkeeping only when the retire ACTED: a
+                        # stale-generation no-op (the slot's live replacement
+                        # re-registered first) must not book an eviction of a
+                        # worker that never left the gate. A disconnect
+                        # retire IS a membership eviction (crash and clean
+                        # close are indistinguishable here) — the rejoin
+                        # records tell the rest of the story.
+                        if controller.retire(self.worker_id,
+                                             generation=self.worker_gen):
+                            from autodist_tpu.parallel import recovery \
+                                as _recovery
+                            _recovery.log_eviction(self.worker_id,
+                                                   kind="disconnect")
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -704,6 +740,10 @@ class PSServer:
         # empty shell when alerting never armed — pollers keep one schema).
         from autodist_tpu.telemetry import alerts as _alerts
         snap["alerts"] = _alerts.alerts_snapshot()
+        # Recovery plane: evictions/rejoins/rollbacks/respawns + per-worker
+        # membership generations (same stable-shell contract as alerts).
+        from autodist_tpu.parallel import recovery as _recovery
+        snap["recovery"] = _recovery.recovery_snapshot()
         controller = getattr(self._runner, "controller", None)
         if controller is not None:
             bound = controller.bound
@@ -876,6 +916,37 @@ class PSClientError(RuntimeError):
     """A server-side failure reported over the transport."""
 
 
+# Per-opcode idempotency contract — the wire-retry policy's ground truth.
+# IDEMPOTENT: repeating the request after a transport failure cannot change
+# server state a second time, so the client may transparently reconnect and
+# retry (AUTODIST_WIRE_RETRIES budget, jittered exponential backoff):
+#   read / read_if_newer / read_min / version / stats / status / trace —
+#     pure reads; ping — stateless echo; push_trace — latest-ring-wins sink;
+#   register — idempotent ONLY with an explicit worker_id (a live slot keeps
+#     its count); register(None) ALLOCATES a fresh slot per request, so a
+#     replay would leave a phantom live slot pinning min(steps) forever —
+#     _retry_safe carves it out;
+#   start_step — re-entering the gate wait moves no counters.
+# NOT idempotent (a failure mid-exchange surfaces to the caller — the
+# request may or may not have landed, and replaying it would double-apply):
+#   apply (one gradient update), finish_step (advances the step count),
+#   record (writes a snapshot dir per request).
+IDEMPOTENT_OPS = frozenset({
+    "read", "read_if_newer", "read_min", "version", "stats", "status",
+    "ping", "trace", "push_trace", "register", "start_step"})
+
+
+def _retry_safe(msg) -> bool:
+    """True when replaying this exact request after a transport failure is
+    safe (see :data:`IDEMPOTENT_OPS` and the register(None) carve-out)."""
+    op = msg[0] if isinstance(msg, tuple) and msg else None
+    if op not in IDEMPOTENT_OPS:
+        return False
+    if op == "register" and (len(msg) < 2 or msg[1] is None):
+        return False   # each replay would allocate another slot
+    return True
+
+
 class _PSClient:
     def __init__(self, address, connect_timeout: float = 60.0,
                  read_timeout: Optional[float] = None):
@@ -887,27 +958,49 @@ class _PSClient:
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             address = (host, int(port))
-        # The chief serves only after its runner.init(); a worker process that
-        # starts faster retries until the server is up.
-        import time
-        deadline = time.monotonic() + connect_timeout
-        while True:
-            try:
-                attempt = min(10.0, max(0.1, deadline - time.monotonic()))
-                self._sock = socket.create_connection(address,
-                                                      timeout=attempt)
-                break
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.2)
-        self._sock.settimeout(read_timeout)
+        from autodist_tpu import const
+        self._address = address
+        self._connect_timeout = float(connect_timeout)
+        self._read_timeout = read_timeout
+        self._retries = max(0, int(const.ENV.AUTODIST_WIRE_RETRIES.val))
+        self._backoff_s = max(0.0,
+                              float(const.ENV.AUTODIST_WIRE_BACKOFF_S.val))
+        self._sock = self._connect(self._connect_timeout)
         self._lock = threading.Lock()
         self._pool = _RecvBuffer()
         # Wire accounting (payload bytes/messages both directions + codec
         # time) — lets callers and tests measure what a protocol change
         # (e.g. read_if_newer) saves.
         self.wire = WireCounters()
+
+    def _connect(self, budget: float) -> socket.socket:
+        """Connect with jittered exponential backoff under a total-deadline
+        budget — the chief serves only after its runner.init(), so a worker
+        process that starts faster (or reconnects through a chief restart)
+        retries refused/reset attempts instead of surfacing the first one."""
+        from autodist_tpu.parallel import recovery as _recovery
+        deadline = time.monotonic() + budget
+        attempt = 0
+        while True:
+            try:
+                if _faults.armed() and _faults.should_fire("wire_refuse"):
+                    raise ConnectionRefusedError(
+                        "injected wire_refuse fault point")
+                per_try = min(10.0, max(0.1, deadline - time.monotonic()))
+                sock = socket.create_connection(self._address,
+                                                timeout=per_try)
+                sock.settimeout(self._read_timeout)
+                return sock
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                # Bounded jittered backoff (recovery.backoff_s caps at 2s
+                # here: a liveness probe's 2s budget must fit retries).
+                time.sleep(min(
+                    max(0.0, deadline - time.monotonic()),
+                    _recovery.backoff_s(attempt, self._backoff_s or 0.2,
+                                        cap_s=2.0)))
+                attempt += 1
 
     @property
     def bytes_sent(self) -> int:
@@ -921,24 +1014,73 @@ class _PSClient:
         """One request/reply exchange accounted into ``counters`` (NOT this
         client's own) and returned unchecked — the overlapped prefetch path,
         whose bytes are attributed only when the result is consumed so
-        ``wire_bytes`` reads stay deterministic while a pull is in flight."""
-        # graftlint: disable=GL001(the lock IS the request/reply pairing — one in-flight exchange per connection; the server replies promptly per-op and close/shutdown unblocks a parked recv)
+        ``wire_bytes`` reads stay deterministic while a pull is in flight.
+
+        Transient transport failures (refused/reset connections) on
+        IDEMPOTENT opcodes reconnect and retry under the
+        ``AUTODIST_WIRE_RETRIES``/``AUTODIST_WIRE_BACKOFF_S`` budget; a
+        non-idempotent op's failure surfaces immediately (the request may
+        have committed — see :data:`IDEMPOTENT_OPS`). A reply-wait TIMEOUT
+        never retries: the reply may still be in flight, and a resend would
+        desync the request/reply pairing."""
+        op = msg[0] if isinstance(msg, tuple) and msg else None
+        attempt = 0
+        # graftlint: disable=GL001(the lock IS the request/reply pairing — one in-flight exchange per connection; the server replies promptly per-op and close/shutdown unblocks a parked recv; the retry's bounded backoff sleeps under it so a concurrent caller cannot interleave on a half-reconnected socket)
         with self._lock:
-            _send_msg(self._sock, msg, counters)
-            reply, _ = _recv_msg(self._sock, pool=self._pool,
-                                 counters=counters)
-        return reply
+            while True:
+                try:
+                    if _faults.armed() \
+                            and _faults.should_fire("wire_reset", op=op):
+                        # Tear the connection down for real so the retry
+                        # exercises the genuine reconnect path.
+                        try:
+                            self._sock.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        self._sock.close()
+                        raise ConnectionResetError(
+                            "injected wire_reset fault point")
+                    _send_msg(self._sock, msg, counters)
+                    reply, _ = _recv_msg(self._sock, pool=self._pool,
+                                         counters=counters)
+                    return reply
+                except (socket.timeout, TimeoutError):
+                    raise
+                except (ConnectionError, OSError) as e:
+                    if not _retry_safe(msg) or attempt >= self._retries:
+                        raise
+                    attempt += 1
+                    from autodist_tpu.parallel import recovery as _recovery
+                    delay = _recovery.backoff_s(attempt - 1,
+                                                self._backoff_s, cap_s=5.0)
+                    logging.warning(
+                        "PS transport: %r failed (%s); reconnecting and "
+                        "retrying idempotent op in %.2fs (attempt %d/%d)",
+                        op, e, delay, attempt, self._retries)
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    time.sleep(delay)   # bounded: cap_s
+                    self._sock = self._connect(self._connect_timeout)
+                    # Fresh buffer: the old one may hold a half-received
+                    # payload aliased by nothing we can trust.
+                    self._pool = _RecvBuffer()
 
     def call(self, *msg):
         reply = self.call_raw(msg, self.wire)
         if reply[0] != "ok":
-            # Re-raise gate timeouts under their real type so callers written
-            # against the AsyncWorker contract (`except StalenessTimeout`) keep
+            # Re-raise gate timeouts and evictions under their real types so
+            # callers written against the AsyncWorker contract (`except
+            # StalenessTimeout` / the rejoin-on-WorkerEvicted path) keep
             # working across the transport.
             kind, detail = reply[1], reply[2]
             if kind == "StalenessTimeout":
                 from autodist_tpu.parallel.staleness import StalenessTimeout
                 raise StalenessTimeout(detail)
+            if kind == "WorkerEvicted":
+                from autodist_tpu.parallel.staleness import WorkerEvicted
+                raise WorkerEvicted(detail)
             raise PSClientError(f"{kind}: {detail}")
         return reply[1:]
 
@@ -1048,6 +1190,40 @@ class RemotePSWorker:
         returns the admitted id (may differ when ``worker_id`` was None)."""
         wid = self._client.call("register", self.worker_id)[0]
         self.worker_id = wid
+        return wid
+
+    def rejoin(self) -> int:
+        """Recover from an eviction WITHOUT a checkpoint: re-register (the
+        gate seeds this worker at the slowest LIVE step count — neither
+        wedging the bound nor surging past it) and catch up to the chief's
+        LIVE parameters over the ``read_min`` path, seeding the conditional-
+        pull cache so the next :meth:`step` revalidates instead of
+        re-downloading. Called automatically when a gate RPC fails with
+        :class:`~autodist_tpu.parallel.staleness.WorkerEvicted`; safe to
+        call manually after any suspected membership loss."""
+        # The eviction may span many service versions: drop the stale
+        # prefetch/cache so nothing pre-eviction can be revalidated.
+        self._prefetch = None
+        self._cached_pull = None
+        self.last_version_read = -1
+        wid = self.register()
+        with telemetry.span("ps.rejoin", worker=wid):
+            try:
+                # read_min(0, -1): released immediately at the service's
+                # CURRENT version — the catch-up pull, one round trip.
+                params, ef_state, version = self._client.call(
+                    "read_min", 0, -1, self.PREFETCH_TIMEOUT)
+            except PSClientError as e:
+                if "unknown op" not in str(e):
+                    raise
+                # Pre-read_min chief: a plain read is the same catch-up.
+                params, ef_state, version = self._client.call("read")
+        if params is not None:
+            self._cached_pull = (params, ef_state)
+            self.last_version_read = version
+        logging.warning(
+            "PS worker %s rejoined the staleness gate and caught up to "
+            "chief version %s (checkpoint-free restart)", wid, version)
         return wid
 
     def warmup(self, batch: PyTree) -> None:
@@ -1160,9 +1336,35 @@ class RemotePSWorker:
         return params, ef_state, version
 
     def step(self, batch: PyTree, timeout: Optional[float] = None):
+        from autodist_tpu.parallel.staleness import WorkerEvicted
         r = self._runner
-        with telemetry.span("ps.gate", worker=self.worker_id):
-            self._client.call("start_step", self.worker_id, timeout)
+        if _faults.armed():
+            # Chaos harness: deterministic hang (the watchdog/eviction
+            # driver) and crash (abrupt socket teardown — the server sees
+            # exactly what a killed process produces) fault points.
+            _faults.maybe_hang(step=self.steps_completed,
+                               worker=self.worker_id)
+            if _faults.should_fire("worker_crash", step=self.steps_completed,
+                                   worker=self.worker_id):
+                self._crash()
+                raise _faults.WorkerCrashed(
+                    f"remote worker {self.worker_id} crashed by fault "
+                    f"injection at step {self.steps_completed}")
+        try:
+            with telemetry.span("ps.gate", worker=self.worker_id):
+                self._client.call("start_step", self.worker_id, timeout)
+        except WorkerEvicted:
+            # Auto-eviction hit this worker (sustained stall — possibly as
+            # the gate's victim, not its culprit): rejoin seeded at the
+            # slowest live count, catch up on live params, and take the
+            # gate again. One retry: a second eviction inside one step
+            # means the chief really wants us gone.
+            logging.warning(
+                "PS worker %s was evicted from the staleness gate; "
+                "rejoining with live-param catch-up", self.worker_id)
+            self.rejoin()
+            with telemetry.span("ps.gate", worker=self.worker_id):
+                self._client.call("start_step", self.worker_id, timeout)
         with telemetry.span("ps.pull", worker=self.worker_id):
             params, ef_state, _ = self._pull()
         with telemetry.span("ps.shard"):
@@ -1247,6 +1449,24 @@ class RemotePSWorker:
     @property
     def version(self) -> int:
         return self._client.call("version")[0]
+
+    def _crash(self):
+        """Abrupt transport teardown (the ``worker_crash`` fault point): no
+        trace push, no goodbye — the server's recv observes EOF exactly as
+        it would for a killed process and retires the slot."""
+        pf, self._prefetch = self._prefetch, None
+        if self._pull_client is not None:
+            try:
+                self._pull_client.close()
+            except OSError:
+                pass
+            self._pull_client = None
+        if pf is not None and pf.thread is not None:
+            pf.thread.join(timeout=5.0)
+        try:
+            self._client.close()
+        except OSError:
+            pass
 
     def close(self):
         from autodist_tpu import const

@@ -35,7 +35,9 @@ import jax
 import numpy as np
 
 from autodist_tpu import telemetry
+from autodist_tpu.parallel import recovery as _recovery
 from autodist_tpu.runner import DistributedRunner, TrainState
+from autodist_tpu.testing import faults as _faults
 from autodist_tpu.telemetry.metrics import COUNT_BUCKETS, Histogram
 from autodist_tpu.utils import logging
 
@@ -73,6 +75,15 @@ def _assign_shards(named: Dict[str, Any], shards: int) -> List[List[str]]:
 
 class StalenessTimeout(TimeoutError):
     """A gated worker step did not become runnable within the timeout."""
+
+
+class WorkerEvicted(RuntimeError):
+    """The worker was retired from the staleness gate while (or before)
+    waiting to step — the auto-eviction path's typed RPC failure. The
+    transport ships it across the wire; :class:`RemotePSWorker` reacts by
+    re-registering (seeded at the slowest live count) and catching up on
+    the chief's live params, so an eviction costs the worker one rejoin,
+    never the run."""
 
 
 _STALENESS_TEL = None
@@ -156,7 +167,18 @@ class StalenessController:
         with self._cond:
             return self._generation.get(worker_id, 0)
 
-    def retire(self, worker_id: int, generation: Optional[int] = None):
+    def slot_state(self, worker_id: Optional[int]) -> str:
+        """``"live"`` / ``"retired"`` / ``"new"`` (never-allocated or None)
+        — lets :meth:`AsyncPSRunner.add_worker` tell a REJOIN (re-admitting
+        a retired slot: the recovery plane's bookkeeping) from a first
+        registration or an idempotent retry."""
+        with self._cond:
+            if worker_id is None or worker_id < 0 \
+                    or worker_id >= len(self._steps):
+                return "new"
+            return "retired" if worker_id in self._retired else "live"
+
+    def retire(self, worker_id: int, generation: Optional[int] = None) -> bool:
         """Remove a dead worker from the gate (its frozen step count would
         otherwise pin min(steps) and wedge every other worker at the bound).
         Used by the PS transport when a remote worker disconnects.
@@ -164,16 +186,24 @@ class StalenessController:
         With ``generation``, the retire applies only if the slot's occupancy
         generation still matches — a handler holding a long-dead socket for a
         slot that a replacement has since re-registered must not retire the
-        live replacement."""
+        live replacement.
+
+        Returns True only when this call actually retired a LIVE worker —
+        a stale-generation ignore or an already-retired slot returns False,
+        so callers' bookkeeping (the recovery plane's eviction records)
+        tracks gate ACTIONS, never no-ops."""
         with self._cond:
             if generation is not None \
                     and generation != self._generation.get(worker_id, 0):
                 logging.info("Ignoring stale retire of worker %d (generation "
                              "%d != current %d)", worker_id, generation,
                              self._generation.get(worker_id, 0))
-                return
+                return False
+            if worker_id in self._retired:
+                return False
             self._retired.add(worker_id)
             self._cond.notify_all()
+            return True
 
     def register(self, worker_id: Optional[int] = None) -> int:
         """Admit a worker to the gate mid-run — a replacement for a retired
@@ -264,12 +294,22 @@ class StalenessController:
             if tel is not None:
                 tel.observe(lag)
             with telemetry.span("ps.gate_wait", worker=worker_id):
-                if not self._cond.wait_for(lambda: self._runnable(worker_id),
-                                           timeout):
+                # A retire (auto-eviction, disconnect) WAKES a parked wait:
+                # the evicted worker's pending gate RPC must fail typed so
+                # its client can rejoin, instead of parking until timeout on
+                # a slot that no longer gates anyone.
+                if not self._cond.wait_for(
+                        lambda: (worker_id in self._retired
+                                 or self._runnable(worker_id)), timeout):
                     raise StalenessTimeout(
                         f"worker {worker_id} at step {self._steps[worker_id]} "
                         f"still >= {self._bound} ahead of the slowest worker "
                         f"after {timeout}s")
+                if worker_id in self._retired:
+                    raise WorkerEvicted(
+                        f"worker {worker_id} was retired from the staleness "
+                        f"gate (evicted or disconnected); re-register to "
+                        f"rejoin")
             return self._generation.get(worker_id, 0)
 
     def finish_step(self, worker_id: int) -> int:
@@ -722,6 +762,18 @@ class AsyncWorker:
         compute local gradients, push to the PS. Returns the local loss (or
         ``(loss, aux)`` when the runner was built with ``has_aux``)."""
         r = self._runner
+        if _faults.armed():
+            # Chaos harness (testing/faults.py): deterministic hang/crash
+            # points so the self-heal tests drive the REAL gate/eviction
+            # machinery. Un-armed cost: one module-global read.
+            _faults.maybe_hang(step=self.steps_completed,
+                               worker=self.worker_id)
+            if _faults.should_fire("worker_crash", step=self.steps_completed,
+                                   worker=self.worker_id):
+                r.controller.retire(self.worker_id)
+                raise _faults.WorkerCrashed(
+                    f"worker {self.worker_id} crashed by fault injection at "
+                    f"step {self.steps_completed}")
         r.controller.start_step(self.worker_id, timeout)
         params, ef_state, version = r.service.read()
         self.last_version_read = version
@@ -984,11 +1036,20 @@ class AsyncPSRunner(DistributedRunner):
         threads (two remote workers may register simultaneously)."""
         if self.service is None:
             raise RuntimeError("Call init(params) before creating workers")
+        # Rejoin detection BEFORE the register: re-admitting a retired slot
+        # is the recovery plane's membership event (a replacement process, or
+        # a wrongly-evicted worker healing itself); a fresh slot or an
+        # idempotent retry on a live one is not. The check/register race is
+        # benign — it only decides bookkeeping, never admission.
+        was_retired = self.controller.slot_state(worker_id) == "retired"
         wid, gen = self.controller.register_with_generation(worker_id)
         with self._membership_lock:
             self.num_workers = max(self.num_workers, wid + 1)
             if wid not in self._workers:
                 self._workers[wid] = AsyncWorker(self, wid)
+        if was_retired:
+            _recovery.log_rejoin(wid, gen,
+                                 seeded_step=self.controller.steps[wid])
         logging.info("AsyncPSRunner: admitted worker %d (gate now %d slots)",
                      wid, len(self.controller.steps))
         if with_generation:

@@ -168,6 +168,11 @@ class InferenceServer:
         # PS status — one console renders both endpoint kinds.
         from autodist_tpu.telemetry import alerts as _alerts
         snap["alerts"] = _alerts.alerts_snapshot()
+        # Recovery plane: same section as the PS status (a serving process
+        # normally has no membership actions — the stable empty shell — but
+        # a co-located trainer's records render identically either way).
+        from autodist_tpu.parallel import recovery as _recovery
+        snap["recovery"] = _recovery.recovery_snapshot()
         return snap
 
     def _wait(self, req, timeout) -> tuple:

@@ -24,7 +24,9 @@ Three planes, one subsystem (docs/usage/observability.md):
   update/param ratio, NaN/Inf count) to the existing jitted step plus a
   host-side loss-spike monitor at log boundaries; anomalies become
   ``health.anomaly`` events and the ``AUTODIST_HEALTH_ACTION`` policy
-  (warn / record / halt) decides the reaction.
+  (warn / record / halt / recover — the last rolls back to the newest
+  last-known-good snapshot and resumes, ``parallel/recovery.py``) decides
+  the reaction.
 - **Flight recorder** (:mod:`autodist_tpu.telemetry.recorder`) — anomaly
   events (watchdog, health, the manual ``record`` wire opcode) capture
   self-contained snapshot dirs (merged cluster trace + metrics/events +
@@ -58,7 +60,8 @@ per train step (``bench.py --health-overhead`` gates the enabled side).
 """
 
 from autodist_tpu.telemetry import alerts, history, openmetrics
-from autodist_tpu.telemetry.alerts import AlertEngine, AlertHalt, AlertRule
+from autodist_tpu.telemetry.alerts import (AlertEngine, AlertHalt,
+                                           AlertRecover, AlertRule)
 from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
                                             dump_events_jsonl,
                                             dump_spans_jsonl,
@@ -71,7 +74,7 @@ from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
                                            opt_state_bytes,
                                            sample_device_memory)
 from autodist_tpu.telemetry.health import (HealthConfig, HealthHalt,
-                                           HealthMonitor)
+                                           HealthMonitor, HealthRecover)
 from autodist_tpu.telemetry.history import MetricsHistory
 from autodist_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                             Registry, counter, event, events,
@@ -98,12 +101,13 @@ __all__ = [
     "collect_cluster_trace", "local_trace_state", "merge_trace_states",
     "dump_spans_jsonl", "load_trace_jsonl", "ntp_offset",
     "dump_events_jsonl", "load_events_jsonl",
-    "HealthConfig", "HealthHalt", "HealthMonitor",
+    "HealthConfig", "HealthHalt", "HealthMonitor", "HealthRecover",
     "FlightRecorder", "set_recorder", "get_recorder", "maybe_record",
     "build_manifest",
     "profiling", "costmodel", "peak_spec", "profile_document",
     "write_profile",
     "alerts", "history", "openmetrics",
-    "AlertEngine", "AlertHalt", "AlertRule", "MetricsHistory",
+    "AlertEngine", "AlertHalt", "AlertRecover", "AlertRule",
+    "MetricsHistory",
     "MetricsExporter", "quantile", "merge_histograms",
 ]

@@ -34,7 +34,9 @@ emits a structured ``alert`` event into the existing ring, bumps
 honors ``AUTODIST_ALERT_ACTION``: ``warn`` logs (rate-limited), ``record``
 arms a recorder on demand, ``halt`` raises :class:`AlertHalt` out of the
 sampling loop (the train loop propagates it; background samplers catch and
-log). Rules load from ``AUTODIST_ALERT_RULES`` (a JSON file path or inline
+log), ``recover`` raises :class:`AlertRecover` — the train loop rolls back
+to its last-known-good snapshot and resumes (``parallel/recovery.py``).
+Rules load from ``AUTODIST_ALERT_RULES`` (a JSON file path or inline
 JSON) on top of :data:`DEFAULT_RULES`; a malformed rule WARNS AND IS
 SKIPPED — alerting must never crash the loop it watches.
 """
@@ -51,11 +53,11 @@ from autodist_tpu import const
 from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
 
-__all__ = ["AlertRule", "AlertEngine", "AlertHalt", "DEFAULT_RULES",
-           "load_rules", "set_engine", "get_engine", "get_or_create",
-           "active_alerts", "alerts_snapshot"]
+__all__ = ["AlertRule", "AlertEngine", "AlertHalt", "AlertRecover",
+           "DEFAULT_RULES", "load_rules", "set_engine", "get_engine",
+           "get_or_create", "active_alerts", "alerts_snapshot"]
 
-ACTIONS = ("warn", "record", "halt")
+ACTIONS = ("warn", "record", "halt", "recover")
 KINDS = ("threshold", "burn_rate", "drift")
 _OPS = {">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
         "<": lambda a, b: a < b, "<=": lambda a, b: a <= b}
@@ -104,6 +106,17 @@ class AlertHalt(RuntimeError):
         super().__init__(f"alert rule(s) fired with action=halt: {names}")
         self.fired = fired
         self.state = None   # the live TrainState, when a train loop raised
+
+
+class AlertRecover(AlertHalt):
+    """The ``AUTODIST_ALERT_ACTION=recover`` control signal — the health
+    plane's recover action, driven by a declarative rule instead of the
+    numerics bundle. ``train()`` catches it, rolls back to the newest
+    last-known-good snapshot (``parallel/recovery.py``) and resumes,
+    escalating after ``AUTODIST_RECOVER_MAX`` attempts; background samplers
+    (timer/scheduler threads) catch it as the :class:`AlertHalt` it
+    subclasses and log — a loop with live requests is not theirs to roll
+    back."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -447,6 +460,8 @@ class AlertEngine:
                 logging.warning("alerts: %s firing: %s", names, fired[-1])
         if self.action == "halt":
             raise AlertHalt(fired)
+        if self.action == "recover":
+            raise AlertRecover(fired)
 
     # --------------------------------------------------------------- queries
 

@@ -43,13 +43,13 @@ from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
 
 __all__ = ["BUNDLE_FIELDS", "device_bundle", "reduce_bundle", "HealthConfig",
-           "HealthMonitor", "HealthHalt"]
+           "HealthMonitor", "HealthHalt", "HealthRecover"]
 
 # The fused scalar bundle's layout (one f32 per field, this order). Kept
 # tiny on purpose: the readback rides the log boundary's existing sync.
 BUNDLE_FIELDS = ("nonfinite", "grad_norm", "update_norm", "param_norm")
 
-ACTIONS = ("warn", "record", "halt")
+ACTIONS = ("warn", "record", "halt", "recover")
 
 
 class HealthHalt(RuntimeError):
@@ -67,6 +67,16 @@ class HealthHalt(RuntimeError):
         self.step = step
         self.state = state
         self.anomalies = anomalies
+
+
+class HealthRecover(HealthHalt):
+    """The ``recover`` action's control signal, raised at the anomalous
+    boundary and CAUGHT INSIDE ``train()``: the loop rolls back to the
+    newest last-known-good snapshot (``parallel/recovery.py``'s ring) and
+    resumes, escalating to a plain :class:`HealthHalt` after
+    ``AUTODIST_RECOVER_MAX`` attempts. A :class:`HealthHalt` subclass so
+    a bare ``except HealthHalt`` in a caller that drives the loop pieces
+    directly still observes it (same payload: step/state/anomalies)."""
 
 
 def device_bundle(grads, updates, params, loss):
@@ -115,7 +125,7 @@ class HealthConfig:
     """Monitor knobs (defaults from the ``AUTODIST_HEALTH*`` flags via
     :meth:`from_env`)."""
 
-    action: str = "warn"        # AUTODIST_HEALTH_ACTION: warn | record | halt
+    action: str = "warn"   # AUTODIST_HEALTH_ACTION: warn|record|halt|recover
     z_max: float = 6.0          # AUTODIST_HEALTH_ZMAX: loss-spike threshold
     ewma_decay: float = 0.9     # EWMA decay for the loss mean/variance
     warmup: int = 8             # losses observed before z-scores can fire
@@ -182,6 +192,14 @@ class HealthMonitor:
     @property
     def should_halt(self) -> bool:
         return bool(self.anomalies) and self.config.action == "halt"
+
+    @property
+    def should_recover(self) -> bool:
+        """True under ``action=recover`` with anomalies observed — the train
+        loop's cue to raise :class:`HealthRecover` at the boundary (the
+        monitor never owns the state, so the raise happens at the call
+        site, exactly like ``should_halt``)."""
+        return bool(self.anomalies) and self.config.action == "recover"
 
     @staticmethod
     def from_env(recorder=None) -> Optional["HealthMonitor"]:

@@ -95,6 +95,16 @@ def build_manifest(reason: str, seq: Optional[int] = None) -> Dict[str, Any]:
             manifest["alerts"] = active
     except Exception:   # diagnostics must never fail the snapshot
         pass
+    try:
+        # Recovery actions so far (evictions/rejoins/rollbacks/respawns) —
+        # an eviction- or rollback-triggered snapshot names what the
+        # runtime already DID about the incident, not just what it saw.
+        from autodist_tpu.parallel import recovery as _recovery
+        rec = _recovery.recovery_snapshot()
+        if any((rec.get("counts") or {}).values()):
+            manifest["recovery"] = rec
+    except Exception:   # diagnostics must never fail the snapshot
+        pass
     return manifest
 
 

@@ -1,0 +1,13 @@
+"""Deterministic test/chaos harnesses shipped WITH the package.
+
+The self-healing runtime (``parallel/recovery.py``) is only trustworthy if
+its chaos paths are driven by REAL failures, not mocks: :mod:`faults`
+provides deterministic, env/arg-keyed fault points (worker crash at step N,
+worker hang, NaN-in-grads, wire connect refusal) that the product code
+consults at a handful of instrumented sites. Un-armed, every site costs one
+module-global read.
+"""
+
+from autodist_tpu.testing import faults
+
+__all__ = ["faults"]

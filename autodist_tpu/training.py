@@ -16,7 +16,9 @@ import numpy as np
 from autodist_tpu import const, telemetry
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.data import prefetch as _prefetch
+from autodist_tpu.parallel import recovery as _recovery
 from autodist_tpu.runner import MicroBatched, TrainState
+from autodist_tpu.testing import faults as _faults
 from autodist_tpu.telemetry import health as _health
 from autodist_tpu.telemetry import history as _history
 from autodist_tpu.telemetry import openmetrics as _openmetrics
@@ -30,14 +32,19 @@ PyTree = Any
 def _observe_health(monitor, runner, step: int, losses,
                     state: TrainState):
     """Feed the health monitor at a log boundary (where the loss readback
-    already synced) and apply the halt policy: ``losses`` is the period's
+    already synced) and apply the policy: ``losses`` is the period's
     per-step loss values (host-side), the bundle is the runner's latest
     device readback. Raises :class:`telemetry.HealthHalt` with the LIVE
-    state attached under ``AUTODIST_HEALTH_ACTION=halt``."""
+    state attached under ``AUTODIST_HEALTH_ACTION=halt``, or
+    :class:`telemetry.HealthRecover` under ``recover`` — ``train()``'s
+    retry wrapper catches the latter, rolls back to the newest
+    last-known-good snapshot, and resumes."""
     bundle = getattr(runner, "last_health", None)
     if bundle is not None:
         bundle = jax.device_get(bundle)
     anomalies = monitor.observe(step, losses, bundle)
+    if anomalies and monitor.should_recover:
+        raise _health.HealthRecover(step, state, anomalies)
     if anomalies and monitor.should_halt:
         raise _health.HealthHalt(step, state, anomalies)
 
@@ -146,6 +153,17 @@ def train(runner, params: PyTree,
     ``AUTODIST_HEALTH_ACTION`` policy; ``halt`` raises
     :class:`telemetry.HealthHalt` carrying the live state. Monitoring needs
     ``log_every > 0`` (boundaries are where readbacks happen).
+
+    ``AUTODIST_HEALTH_ACTION=recover`` (and its alert-engine twin
+    ``AUTODIST_ALERT_ACTION=recover``) turns detection into self-healing:
+    the loop keeps a bounded ring of last-known-good states captured at
+    health-clean log boundaries, and an anomaly ROLLS BACK to the newest
+    good one and resumes — replaying the rolled-back steps exactly when
+    ``batches`` is a callable (an iterable source continues on its next
+    unconsumed items instead). At most ``AUTODIST_RECOVER_MAX`` rollback
+    attempts; exhaustion (or an anomaly before any healthy boundary)
+    escalates to the existing :class:`telemetry.HealthHalt` /
+    :class:`telemetry.AlertHalt`. See ``docs/usage/resilience.md``.
     """
     if unroll is None:
         tuned = getattr(runner, "tuned_plan", None)
@@ -260,44 +278,106 @@ def train(runner, params: PyTree,
         _profiling.maybe_write_profile()
         return final_state
 
-    if use_blocks:
-        # Async input pipeline for the fused loop: the producer gathers the
-        # NEXT blocks (clipped at the same cadence boundaries the sync path
-        # uses) and pre-shards them (shard_block = stacking + async
-        # device_put) up to prefetch_depth blocks ahead, so the BatchBlock
-        # queue feeds without blocking at block assembly.
-        feed = None
-        if prefetch_depth > 0:
-            feed = _BlockFeed(
-                runner, next_batch, batch_iter, start, steps, unroll,
-                _boundary_fn(steps, save_every if saver is not None else 0,
-                             eval_every), prefetch_depth)
+    # Recover-and-resume policy (parallel/recovery.py): under
+    # AUTODIST_HEALTH_ACTION=recover (or the alert-engine twin) the loops
+    # push the state into a bounded last-known-good ring at every HEALTHY
+    # log boundary, and an anomaly rolls back to the newest good snapshot
+    # and re-enters the loop — bounded by AUTODIST_RECOVER_MAX attempts
+    # before escalating to the existing halt.
+    recover_armed = (
+        (monitor is not None and monitor.config.action == "recover")
+        or str(const.ENV.AUTODIST_ALERT_ACTION.val) == "recover")
+    ring = None
+    if recover_armed:
+        # Ring entries must OWN their buffers: the sync runner's step
+        # DONATES its input state, so a bare reference would be deleted by
+        # the dispatch right after the push. One fused on-device copy per
+        # healthy boundary (sharding-preserving; recover is opt-in and log
+        # boundaries are sparse — the copy is the price of a rollback
+        # target that survives donation).
+        import jax.numpy as jnp
+        ring = _recovery.SnapshotRing(copy_fn=jax.jit(
+            lambda s: jax.tree_util.tree_map(jnp.copy, s)))
+    if ring is not None and batch_iter is not None:
+        logging.warning(
+            "train: recover action with an ITERABLE batch source — a "
+            "rollback cannot replay consumed batches, so the resumed loop "
+            "continues on the next unconsumed ones (pass a callable "
+            "batches(step) source for exact replay)")
+
+    def _run_attempt(attempt_state: TrainState) -> TrainState:
+        """One pass of the chosen loop from ``attempt_state``'s own step —
+        feeds are (re)built per attempt so a rollback's replay pulls the
+        rolled-back step range, not the crashed attempt's readahead."""
+        start_i = int(attempt_state.step)
+        if use_blocks:
+            # Async input pipeline for the fused loop: the producer gathers
+            # the NEXT blocks (clipped at the same cadence boundaries the
+            # sync path uses) and pre-shards them (shard_block = stacking +
+            # async device_put) up to prefetch_depth blocks ahead, so the
+            # BatchBlock queue feeds without blocking at block assembly.
+            feed = None
+            if prefetch_depth > 0:
+                feed = _BlockFeed(
+                    runner, next_batch, batch_iter, start_i, steps, unroll,
+                    _boundary_fn(steps,
+                                 save_every if saver is not None else 0,
+                                 eval_every), prefetch_depth)
+            try:
+                return _unrolled_loop(
+                    runner, attempt_state, next_batch, batch_iter, start_i,
+                    steps, unroll, saver, prefix_base, save_participant,
+                    save_every, async_save, log_every, batch_size,
+                    on_metrics, eval_every, eval_batch, eval_fn, on_eval,
+                    monitor, feed, ring)
+            finally:
+                if feed is not None:
+                    feed.close()
+        # Async input pipeline: with prefetch_depth > 0 a background
+        # producer pulls host batches AND applies the feed remapping
+        # (shard_batch = async device_put) up to `depth` ahead, so the
+        # loop's train.data_wait span measures only the residual queue
+        # wait. The producer books data.producer_wait/queue_depth, keeping
+        # a slow loader visible.
+        feed = _step_feed(runner, next_batch, batch_iter, start_i, steps,
+                          prefetch_depth) if prefetch_depth > 0 else None
         try:
-            return _finish(_unrolled_loop(
-                runner, state, next_batch, batch_iter, start, steps, unroll,
-                saver, prefix_base, save_participant, save_every, async_save,
-                log_every, batch_size, on_metrics, eval_every, eval_batch,
-                eval_fn, on_eval, monitor, feed))
+            return _per_step_loop(
+                runner, attempt_state, feed, next_batch, batch_iter,
+                start_i, steps, saver, prefix_base, save_participant,
+                save_every, async_save, log_every, batch_size, on_metrics,
+                eval_every, eval_batch, eval_fn, on_eval, monitor, ring)
         finally:
             if feed is not None:
                 feed.close()
 
-    # Async input pipeline: with prefetch_depth > 0 a background producer
-    # pulls host batches AND applies the feed remapping (shard_batch =
-    # async device_put) up to `depth` ahead, so the loop's train.data_wait
-    # span measures only the residual queue wait. The producer books
-    # data.producer_wait/queue_depth, keeping a slow loader visible.
-    feed = _step_feed(runner, next_batch, batch_iter, start, steps,
-                      prefetch_depth) if prefetch_depth > 0 else None
-    try:
-        state = _per_step_loop(
-            runner, state, feed, next_batch, batch_iter, start, steps,
-            saver, prefix_base, save_participant, save_every, async_save,
-            log_every, batch_size, on_metrics, eval_every, eval_batch,
-            eval_fn, on_eval, monitor)
-    finally:
-        if feed is not None:
-            feed.close()
+    attempt = 0
+    last_fail_step = None
+    while True:
+        try:
+            state = _run_attempt(state)
+            break
+        except (_health.HealthRecover, telemetry.AlertRecover) as e:
+            # AUTODIST_RECOVER_MAX bounds attempts PER INCIDENT, not per
+            # run: an anomaly at a LATER step than the last one means the
+            # earlier incident was overcome (training progressed past it),
+            # so the budget resets — three transient spikes hours apart
+            # must not spend a lifetime cap and turn the fourth into a
+            # halt. A repeat at the same (or an unknown) step is the same
+            # incident and keeps counting toward escalation.
+            fail_step = getattr(e, "step", None)
+            if fail_step is not None and last_fail_step is not None \
+                    and fail_step > last_fail_step:
+                attempt = 0
+            if fail_step is not None:
+                last_fail_step = fail_step
+            attempt += 1
+            # Returns the newest good state (re-seeding an async runner's
+            # service), or escalates to HealthHalt/AlertHalt when the ring
+            # is empty or AUTODIST_RECOVER_MAX is spent.
+            state = _recovery.rollback(e, ring, attempt,
+                                       _recovery.recover_max(),
+                                       runner=runner)
     return _finish(state)
 
 
@@ -329,9 +409,11 @@ def _per_step_loop(runner, state: TrainState, feed, next_batch, batch_iter,
                    save_participant, save_every: int, async_save: bool,
                    log_every: int, batch_size: Optional[int], on_metrics,
                    eval_every: int, eval_batch, eval_fn, on_eval,
-                   monitor) -> TrainState:
+                   monitor, ring=None) -> TrainState:
     """The classic one-dispatch-per-step loop (``unroll=1``), fed either
-    synchronously or from the async prefetch producer (``feed``)."""
+    synchronously or from the async prefetch producer (``feed``).
+    ``ring`` (a :class:`recovery.SnapshotRing`) receives the state at every
+    boundary that closes healthy — the recover action's rollback targets."""
     meter = None
     loss = None
     # Health monitoring: per-step device losses accumulate here (tiny device
@@ -358,6 +440,13 @@ def _per_step_loop(runner, state: TrainState, feed, next_batch, batch_iter,
             except StopIteration:
                 logging.info("train: batch iterator exhausted at step %d", step_i)
                 break
+        if _faults.armed() and _faults.should_fire("nan_grads", step=step_i):
+            # Chaos harness (testing/faults.py): NaN-fill the batch's float
+            # leaves so the REAL compiled step produces real NaN gradients —
+            # the recover-action tests and bench drive genuine anomalies,
+            # not mocks. Un-armed cost: one module-global read per step.
+            logging.warning("faults: injecting NaN batch at step %d", step_i)
+            batch = _faults.corrupt_batch(batch)
         with telemetry.span("train.dispatch"):
             state, fetched = runner.run(state, batch)
         loss = fetched[0] if isinstance(fetched, tuple) else fetched
@@ -424,6 +513,13 @@ def _per_step_loop(runner, state: TrainState, feed, next_batch, batch_iter,
                 except telemetry.AlertHalt as e:
                     e.state = state
                     raise
+                # The boundary closed HEALTHY (no health anomaly raised, no
+                # alert fired past this point): this state is a valid
+                # rollback target. push() DEEP-COPIES on device via the
+                # ring's copy_fn — the step donates its input buffers, so a
+                # bare reference would be deleted by the next dispatch.
+                if ring is not None:
+                    ring.push(step_i + 1, state)
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
         if (eval_every and (step_i + 1) % eval_every == 0
@@ -542,8 +638,8 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                    saver, prefix_base, save_participant, save_every: int,
                    async_save: bool, log_every: int, batch_size: Optional[int],
                    on_metrics, eval_every: int, eval_batch, eval_fn,
-                   on_eval, monitor=None, feed: Optional[_BlockFeed] = None
-                   ) -> TrainState:
+                   on_eval, monitor=None, feed: Optional[_BlockFeed] = None,
+                   ring=None) -> TrainState:
     """The fused dispatch-ahead pipeline behind ``train(..., unroll=K)``.
 
     Consecutive batches are gathered into blocks of up to ``unroll`` steps and
@@ -664,6 +760,11 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                 except telemetry.AlertHalt as e:
                     e.state = state
                     raise
+                # Healthy-boundary snapshot for the recover action (the
+                # per-step loop's contract: push() deep-copies on device to
+                # survive the step's buffer donation).
+                if ring is not None:
+                    ring.push(step_i, state)
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)
         if eval_every and step_i % eval_every == 0:

@@ -1451,6 +1451,185 @@ def data_plane_bench(steps: int = 96, log_every: int = 32, rounds: int = 3,
     return result
 
 
+def selfheal_bench(steps_per_worker: int = 60, crash_at: int = 25,
+                   dim: int = 256):
+    """Self-healing runtime gate: kill one async-PS worker mid-run with the
+    REAL fault harness (``testing/faults.py`` — abrupt socket teardown, the
+    server sees exactly what a killed process produces), let the recovery
+    plane evict it and the supervising harness respawn a replacement that
+    re-registers and catches up on the chief's LIVE params over the
+    ``read_min`` path, and measure what the incident cost. Gated numbers in
+    the PERF_BASELINE.json ``selfheal`` row:
+
+    - the faulted run COMPLETES (every planned step applied) with FINITE
+      final params — the acceptance property itself;
+    - ``post_vs_free``: steps/s from the crash moment to the end of the
+      faulted run must be >= ``min_ratio`` (0.6) of the fault-free run's
+      steps/s — eviction + rejoin + catch-up must cost a blip, not the run;
+    - the recovery plane actually acted: >= 1 eviction and >= 1 rejoin
+      booked (driving real failures is the point — a silent pass with no
+      membership action means the fault never fired)."""
+    import sys
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.parallel import recovery
+    from autodist_tpu.parallel.ps_transport import PSServer, RemotePSWorker
+    from autodist_tpu.strategy import PS
+    from autodist_tpu.testing import faults
+
+    platform = jax.devices()[0].platform
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(dim, 1).astype(np.float32)
+
+    def batch_for(seed):
+        r = np.random.RandomState(seed)
+        x = r.randn(64, dim).astype(np.float32)
+        return {"x": x, "y": x @ w_true + 0.01 * r.randn(64, 1)
+                .astype(np.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["y"] - b["x"] @ p["w"]) ** 2)
+
+    def params_init():
+        return {"w": np.zeros((dim, 1), np.float32)}
+
+    n_workers = 2
+
+    def run_leg(crash):
+        """One full run: ``n_workers`` remote workers over a loopback
+        PSServer, ``steps_per_worker`` steps each; with ``crash``, worker 1
+        dies at its step ``crash_at`` and the harness respawns a
+        replacement (the coordinator's AUTODIST_WORKER_FAILURE=respawn
+        policy in miniature — in-process so the bench is subprocess-free).
+        Returns (total steps/s, post-crash steps/s, final params)."""
+        # Fresh recovery log per leg: the clean legs' teardown books
+        # disconnect retires too, and the acted-check below must measure
+        # THIS leg's fault, not accumulated teardown noise.
+        recovery.reset()
+        ad = AutoDist(strategy_builder=PS(staleness=4))
+        runner = ad.create_distributed_session(
+            loss_fn, params_init(), optax.sgd(0.05),
+            example_batch=batch_for(0), num_workers=n_workers)
+        runner.init(params_init())
+        server = PSServer(runner, host="127.0.0.1", watchdog=False)
+        addr = "%s:%d" % server.address
+        if crash:
+            faults.install(f"worker_crash@step={crash_at},worker=1")
+        crash_t = {}
+
+        def drive(worker_id):
+            worker = RemotePSWorker(addr, runner, worker_id=worker_id)
+            i = 0
+            while i < steps_per_worker:
+                try:
+                    worker.step(batch_for(worker_id * 10_000 + i),
+                                timeout=120)
+                    i += 1
+                except faults.WorkerCrashed:
+                    crash_t["t"] = time.perf_counter()
+                    crash_t["applies"] = runner.service.updates_applied
+                    deadline = time.time() + 30
+                    while worker_id not in runner.controller._retired \
+                            and time.time() < deadline:
+                        time.sleep(0.005)
+                    # Bounded backoff, then the replacement registers and
+                    # catches up over read_min (RemotePSWorker.rejoin path
+                    # runs inside register+first pull).
+                    time.sleep(recovery.backoff_s(0, 0.05, cap_s=0.2))
+                    worker = RemotePSWorker(addr, runner,
+                                            worker_id=worker_id)
+            worker.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=drive, args=(wid,))
+                   for wid in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        total = runner.service.updates_applied
+        post_rate = None
+        if crash and "t" in crash_t:
+            post_rate = (total - crash_t["applies"]) \
+                / max(1e-9, time.perf_counter() - crash_t["t"])
+        final = jax.device_get(runner.service.state.params)
+        # Leg-scoped recovery counts. NOTE: "evicted" includes the drive
+        # threads' clean-close disconnect retires, not just the crash — the
+        # REJOIN count is the fault-specific signal (only a retired slot's
+        # re-registration books one, and nothing in a clean leg retires
+        # before re-registering).
+        counts = recovery.recovery_snapshot()["counts"]
+        faults.clear()
+        server.close()
+        runner.close()
+        return total / dt, post_rate, total, final, counts
+
+    run_leg(False)   # warmup: absorbs first-process costs (native build,
+    #                  transport setup) so the two timed legs pay equally
+    free_rate, _, free_total, _, _ = run_leg(False)
+    fault_rate, post_rate, fault_total, final, rec = run_leg(True)
+
+    finite = all(np.isfinite(np.asarray(l)).all()
+                 for l in jax.tree_util.tree_leaves(final))
+    completed = fault_total == n_workers * steps_per_worker
+    ratio = (post_rate or 0.0) / free_rate if free_rate else 0.0
+
+    result = {
+        "metric": f"selfheal ({platform}, {n_workers} workers x "
+                  f"{steps_per_worker} steps, dim {dim}, worker 1 killed "
+                  f"at step {crash_at})",
+        "unit": "steps/s",
+        "rows": {"fault_free": round(free_rate, 2),
+                 "faulted_total": round(fault_rate, 2),
+                 "post_eviction": round(post_rate or 0.0, 2)},
+        "post_vs_free": round(ratio, 4),
+        "completed": completed,
+        "finite_params": finite,
+        "evicted": rec["evicted"],
+        "rejoined": rec["rejoined"],
+    }
+    if not completed:
+        print(f"WARNING: faulted run applied {fault_total} of "
+              f"{n_workers * steps_per_worker} planned steps — the "
+              f"replacement did not finish the crashed worker's share",
+              file=sys.stderr)
+    if not finite:
+        print("WARNING: faulted run's final params are not finite — the "
+              "catch-up pull adopted corrupt state", file=sys.stderr)
+    if rec["rejoined"] < 1:
+        # The rejoin is the discriminating check: clean teardown books
+        # disconnect evictions too, but only the crashed slot's replacement
+        # re-registers a RETIRED slot.
+        print("WARNING: recovery plane booked no rejoin — the injected "
+              "crash never exercised the self-heal path", file=sys.stderr)
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("selfheal")
+        if recorded and recorded.get("platform") == platform:
+            floor = recorded.get("min_ratio", 0.6)
+            if ratio < floor:
+                print(f"WARNING: post-eviction throughput is {ratio:.2f}x "
+                      f"the fault-free rate, below the {floor:.2f}x floor "
+                      f"— eviction/rejoin/catch-up got expensive (see "
+                      f"PERF_BASELINE.json selfheal)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["post_eviction"],
+                        "unit": "steps/s",
+                        "post_vs_free": result["post_vs_free"],
+                        "evicted": rec["evicted"],
+                        "rejoined": rec["rejoined"]})
+    return result
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -1531,6 +1710,14 @@ def main(argv=None):
              "data.producer_wait still naming the loader, bit-identical "
              "params)")
     parser.add_argument(
+        "--selfheal", action="store_true",
+        help="measure the self-healing runtime: kill one async-PS worker "
+             "mid-run with the fault harness (testing/faults.py), let the "
+             "recovery plane evict it and a respawned replacement rejoin + "
+             "catch up over read_min, gated against the selfheal row in "
+             "PERF_BASELINE.json (run completes with finite params; "
+             "post-eviction steps/s >= min_ratio x fault-free)")
+    parser.add_argument(
         "--autotune", action="store_true",
         help="run the plan autotuner's full predict-prune-probe search on "
              "the CPU micro-model and gate the winner: tuned plan steps/s "
@@ -1569,6 +1756,9 @@ def main(argv=None):
         return
     if args.data_plane:
         data_plane_bench()
+        return
+    if args.selfheal:
+        selfheal_bench()
         return
     if args.autotune:
         autotune_bench()

@@ -127,6 +127,11 @@ python bench.py --trace-pull-overhead
 # the slow loader via data.producer_wait, and stay bit-identical
 # (data_plane row).
 python bench.py --data-plane
+# Self-healing runtime gate: a worker killed mid-run by the fault harness
+# must be evicted, respawned, and caught up over read_min, with the run
+# completing on finite params at >= min_ratio of the fault-free steps/s
+# after the eviction point (selfheal row).
+python bench.py --selfheal
 # Plan-autotuner gate: the predict-prune-probe search must measure at most
 # top-k of the enumerated candidates and its winner must not lose to the
 # default plan (autotune row: tuned/default >= min_ratio).

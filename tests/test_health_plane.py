@@ -355,6 +355,14 @@ def test_status_and_record_opcodes_over_loopback(tmp_path):
         # plane never armed, the live active/resolved records when it did.
         assert status["alerts"] == {"active": [], "resolved": [],
                                     "rules": 0, "action": ""}
+        # The recovery section (same stable-shell contract; pinned by SHAPE
+        # — earlier suites' disconnect retires legitimately book records in
+        # the process-global log, so emptiness is not the invariant).
+        assert set(status["recovery"]) == {
+            "evictions", "rejoins", "rollbacks", "respawns", "counts",
+            "generations"}
+        assert set(status["recovery"]["counts"]) == {
+            "evicted", "rejoined", "rollbacks", "respawns"}
         from autodist_tpu.telemetry import alerts as _alerts
         eng = _alerts.AlertEngine(rules=[_alerts.AlertRule(
             name="pin", kind="threshold", metric="train.mfu", op=">",

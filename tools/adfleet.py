@@ -112,6 +112,14 @@ def _row(address: str, status: dict) -> str:
     if active:
         cols.append("ALERT " + ",".join(sorted(a.get("rule", "?")
                                                for a in active)))
+    counts = (status.get("recovery") or {}).get("counts") or {}
+    if any(counts.values()):
+        # Compact recovery fingerprint: evictions/rejoins/rollbacks/respawns
+        # this process has performed — a replica that has been self-healing
+        # is visible in the fleet table, not just on its own adtop screen.
+        cols.append("recov E%d/J%d/B%d/S%d" % (
+            counts.get("evicted", 0), counts.get("rejoined", 0),
+            counts.get("rollbacks", 0), counts.get("respawns", 0)))
     return "  ".join(cols)
 
 

@@ -145,6 +145,33 @@ def _alert_lines(alerts: dict) -> list:
     return out
 
 
+def _recovery_lines(status: dict) -> list:
+    """The status payload's ``recovery`` section: one summary line of action
+    counts (evictions/rejoins/rollbacks/respawns) plus the newest record per
+    non-empty category. Nothing when the runtime never acted — the healthy
+    screen stays unchanged."""
+    rec = status.get("recovery") or {}
+    counts = rec.get("counts") or {}
+    if not any(counts.values()):
+        return []
+    head = "  ".join(f"{name} {counts[name]}"
+                     for name in ("evicted", "rejoined", "rollbacks",
+                                  "respawns") if counts.get(name))
+    gens = rec.get("generations") or {}
+    if gens:
+        head += "  gen " + ",".join(f"w{w}:{g}" for w, g in gens.items())
+    out = [f"recover  {head}"]
+    for label, key in (("evicted", "evictions"), ("rejoined", "rejoins"),
+                       ("rollback", "rollbacks"), ("respawn", "respawns")):
+        records = rec.get(key) or []
+        if records:
+            last = dict(records[-1])
+            last.pop("t_wall_s", None)
+            fields = " ".join(f"{k}={v}" for k, v in sorted(last.items()))
+            out.append(f"  last {label}: {fields}")
+    return out
+
+
 def _staleness_compact(hist: dict) -> str:
     body = ",".join(f"{k[3:]}:{n}" for k, n in hist.items()
                     if k.startswith("le:") and n)
@@ -221,6 +248,7 @@ def render(status: dict, address: str = "") -> str:
     lines.extend(_perf_lines(reg))
     lines.extend(_health_lines(reg))
     lines.extend(_alert_lines(status.get("alerts") or {}))
+    lines.extend(_recovery_lines(status))
     events = status.get("events") or status.get("anomalies") or []
     if events:
         lines.append(f"events   ({len(events)} recorded, newest last)")
