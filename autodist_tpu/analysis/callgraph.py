@@ -1,14 +1,15 @@
 """Small intra-module AST call-graph utilities shared by the checks.
 
-Scope is deliberately one module: graftlint's concurrency checks need to see
-through local helpers (``_send_msg -> _send_payload -> sock.sendmsg``), not
-across the whole import graph. Resolution covers the two shapes this codebase
-uses: bare-name calls to module-level functions, and ``self.x()`` calls to
-methods of the enclosing class.
+Scope here is one module: bare-name calls to module-level functions and
+``self.x()`` calls to methods of the enclosing class — the building blocks.
+Cross-module resolution (imports, ``module.f()`` chains, instance typing,
+re-export chains) lives in :mod:`autodist_tpu.analysis.program`, which
+composes these utilities into the whole-program :class:`ProgramIndex` the
+interprocedural checks (GL001/GL002/GL009-GL011) run on.
 """
 
 import ast
-from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 
 def dotted_name(node) -> Optional[str]:
@@ -42,34 +43,21 @@ def name_tokens(name: Optional[str]) -> Set[str]:
 
 
 class ModuleIndex:
-    """Per-module map of callable definitions for bounded call resolution."""
+    """Per-module map of callable definitions. Call RESOLUTION lives in
+    :class:`~autodist_tpu.analysis.program.ProgramIndex`, which consumes
+    these maps — this class only indexes what one module defines."""
 
     def __init__(self, tree: ast.Module):
         self.module_funcs: Dict[str, ast.FunctionDef] = {}
         self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
-        self.func_class: Dict[int, Optional[str]] = {}  # id(def) -> class name
         for node in tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.module_funcs[node.name] = node
-                self.func_class[id(node)] = None
             elif isinstance(node, ast.ClassDef):
                 for item in node.body:
                     if isinstance(item, (ast.FunctionDef,
                                          ast.AsyncFunctionDef)):
                         self.methods[(node.name, item.name)] = item
-                        self.func_class[id(item)] = node.name
-
-    def resolve(self, call: ast.Call,
-                current_class: Optional[str]) -> Optional[ast.FunctionDef]:
-        """The local FunctionDef a call lands in, when statically knowable."""
-        func = call.func
-        if isinstance(func, ast.Name):
-            return self.module_funcs.get(func.id)
-        if isinstance(func, ast.Attribute) \
-                and isinstance(func.value, ast.Name) \
-                and func.value.id in ("self", "cls") and current_class:
-            return self.methods.get((current_class, func.attr))
-        return None
 
 
 def calls_under(node) -> Iterator[ast.Call]:
@@ -77,6 +65,26 @@ def calls_under(node) -> Iterator[ast.Call]:
     for sub in ast.walk(node):
         if isinstance(sub, ast.Call):
             yield sub
+
+
+def innermost_function(tree: ast.Module, node) -> Optional[ast.FunctionDef]:
+    """The innermost FunctionDef/AsyncFunctionDef whose span contains
+    ``node``'s line, or None at module level — the shared scope lookup the
+    program-level checks use for local-type inference. The per-module span
+    index is built once and memoized ON the tree object (lifetime-correct:
+    it dies with the tree), so each lookup is O(defs), not O(AST)."""
+    spans = getattr(tree, "_graftlint_fn_spans", None)
+    if spans is None:
+        spans = [(fn.lineno, fn.end_lineno or fn.lineno, fn)
+                 for fn in ast.walk(tree)
+                 if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        tree._graftlint_fn_spans = spans
+    best = None
+    line = node.lineno
+    for start, end, fn in spans:
+        if start <= line <= end and (best is None or start >= best.lineno):
+            best = fn
+    return best
 
 
 def walk_executed(node) -> Iterator[ast.AST]:
@@ -109,46 +117,7 @@ def calls_executed(node) -> Iterator[ast.Call]:
             yield sub
 
 
-def find_reaching_call(
-        index: ModuleIndex, start_nodes: List[ast.AST],
-        current_class: Optional[str],
-        predicate: Callable[[ast.Call], Optional[str]],
-        max_depth: int = 5) -> Optional[Tuple[ast.Call, str, List[str]]]:
-    """BFS from ``start_nodes`` through locally-resolvable calls for the first
-    call where ``predicate`` returns a non-None label.
-
-    Returns ``(top_level_call, label, path)`` where ``top_level_call`` is the
-    call *in the start nodes* that leads there and ``path`` names the hop
-    chain (for the finding message). Depth-limited and cycle-safe."""
-    for top in start_nodes:
-        for call in calls_executed(top):
-            hit = _search(index, call, current_class, predicate,
-                          max_depth, visited=set())
-            if hit is not None:
-                label, path = hit
-                return call, label, path
-    return None
-
-
-def _search(index: ModuleIndex, call: ast.Call,
-            current_class: Optional[str], predicate, depth: int,
-            visited: Set[int]) -> Optional[Tuple[str, List[str]]]:
-    label = predicate(call)
-    name = dotted_name(call.func) or "<dynamic>"
-    if label is not None:
-        return label, [name]
-    if depth <= 0:
-        return None
-    target = index.resolve(call, current_class)
-    if target is None or id(target) in visited:
-        return None
-    visited.add(id(target))
-    callee_class = index.func_class.get(id(target), current_class)
-    for stmt in target.body:
-        for inner in calls_executed(stmt):
-            hit = _search(index, inner, callee_class, predicate, depth - 1,
-                          visited)
-            if hit is not None:
-                label, path = hit
-                return label, [name] + path
-    return None
+# The intra-module reaching-call search that used to live here was
+# superseded by the cross-module version in
+# :meth:`autodist_tpu.analysis.program.ProgramIndex.find_reaching_call` —
+# one search, one set of semantics.

@@ -7,13 +7,20 @@ programs interleaved their collective rendezvous (fixed by
 ``AsyncPSRunner._collective_lock``), and ``staleness.ParameterService``
 documents a strict ``_write_mutex -> _lock`` order plus a "device execution
 never runs under the snapshot lock" rule that nothing previously enforced.
+
+GL001 and GL002 are WHOLE-PROGRAM checks: the lock-body reachability search
+runs over :class:`~autodist_tpu.analysis.program.ProgramIndex`, so a
+``with lock:`` body that reaches ``runner.run`` or a socket send *through
+another module* (the historical blind spot — resolution used to stop at
+5 same-module hops) fails lint too.
 """
 
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from autodist_tpu.analysis import callgraph
-from autodist_tpu.analysis.core import Context, Finding, Module, register
+from autodist_tpu.analysis.core import (Context, Finding, Module, register,
+                                        register_program)
 
 _LOCK_TOKENS = {"lock", "rlock", "mutex", "mtx", "cond", "condition",
                 "sem", "semaphore"}
@@ -81,155 +88,290 @@ def _enclosing_class(module: Module, index: callgraph.ModuleIndex,
     return None
 
 
-@register("GL001", "lock held across device dispatch / blocking I/O")
-def check_lock_across_dispatch(module: Module,
-                               ctx: Context) -> List[Finding]:
-    """GL001 — lock-held-across-dispatch.
+def _dispatch_predicate(jitted_by_module: Dict[str, Set[str]]):
+    """The GL001 blocking-call predicate, program-aware: jitted-name sets
+    are per MODULE (the module whose code the search is currently in)."""
 
-    Flags a ``with <lock>:`` body that reaches (directly or through
-    same-module helpers, up to 5 hops) a blocking operation: a jit-compiled
-    callable, ``runner.run``/``run_many``, ``jax.block_until_ready``, or
-    socket send/recv. Holding a lock across multi-device XLA execution can
-    wedge the collective rendezvous — the PR 2 deadlock, which hung the whole
-    tier-1 suite 3/3 on a 2-core box — and holding a hot-path snapshot lock
-    across device execution stalls every reader for a whole program
-    (the ``staleness.ParameterService`` rule: the apply's device execution
-    runs under the writer mutex only, never the snapshot Condition).
-
-    Locks that exist precisely to serialize execution (e.g.
-    ``AsyncPSRunner._collective_lock``) are legitimate; annotate those sites
-    with ``# graftlint: disable=GL001(reason)`` so the intent is explicit and
-    reviewed, instead of implicit and forgettable.
-    """
-    if module.tree is None:
-        return []
-    findings: List[Finding] = []
-    definite = _definite_locks(module.tree)
-    jitted = _jitted_names(module.tree)
-    index = callgraph.ModuleIndex(module.tree)
-
-    def predicate(call: ast.Call) -> Optional[str]:
+    def predicate(call: ast.Call, info) -> Optional[str]:
         dotted = callgraph.dotted_name(call.func)
         last = callgraph.last_attr(call.func)
         if last in _DISPATCH_ATTRS:
             return dotted or last
         if last in _DISPATCH_METHODS and isinstance(call.func, ast.Attribute):
             return dotted or last
+        jitted = jitted_by_module.get(info.relpath)
+        if jitted is None:
+            jitted = _jitted_names(info.module.tree)
+            jitted_by_module[info.relpath] = jitted
         if dotted is not None and dotted in jitted:
             return f"{dotted} (jitted)"
         return None
 
-    for node in ast.walk(module.tree):
-        if not isinstance(node, (ast.With, ast.AsyncWith)):
-            continue
-        for item in node.items:
-            lock = _lock_name(item.context_expr, definite)
-            if lock is None:
+    return predicate
+
+
+def _scope_function(module: Module, node):
+    """The enclosing FunctionDef of ``node`` (for local-type inference), or
+    None at module level."""
+    return callgraph.innermost_function(module.tree, node)
+
+
+@register_program("GL001", "lock held across device dispatch / blocking I/O")
+def check_lock_across_dispatch(program, ctx: Context) -> List[Finding]:
+    """GL001 — lock-held-across-dispatch (interprocedural).
+
+    Flags a ``with <lock>:`` body that reaches a blocking operation — a
+    jit-compiled callable, ``runner.run``/``run_many``,
+    ``jax.block_until_ready``, or socket send/recv — directly or through
+    helper calls, ACROSS MODULE BOUNDARIES: resolution runs over the
+    whole-program call graph (imports, ``module.f()`` chains, methods of
+    locally-constructed instances; bounded at
+    :data:`~autodist_tpu.analysis.program.MAX_DEPTH` hops). Holding a lock
+    across multi-device XLA execution can wedge the collective rendezvous —
+    the PR 2 deadlock, which hung the whole tier-1 suite 3/3 on a 2-core
+    box — and holding a hot-path snapshot lock across device execution
+    stalls every reader for a whole program (the
+    ``staleness.ParameterService`` rule: the apply's device execution runs
+    under the writer mutex only, never the snapshot Condition). The old
+    same-module 5-hop limit was the documented blind spot this closes: a
+    critical section that reached a socket send through an imported helper
+    passed lint until now.
+
+    Locks that exist precisely to serialize execution (e.g.
+    ``AsyncPSRunner._collective_lock``) are legitimate; annotate those sites
+    with ``# graftlint: disable=GL001(reason)`` so the intent is explicit and
+    reviewed, instead of implicit and forgettable.
+    """
+    findings: List[Finding] = []
+    jitted_by_module: Dict[str, Set[str]] = {}
+    predicate = _dispatch_predicate(jitted_by_module)
+    for info in program.modules():
+        module = info.module
+        definite = _definite_locks(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
-            cls = _enclosing_class(module, index, node)
-            hit = callgraph.find_reaching_call(
-                index, list(node.body), cls, predicate)
-            if hit is None:
-                continue
-            _, label, path = hit
-            via = " via " + " -> ".join(path[:-1]) if len(path) > 1 else ""
-            findings.append(Finding(
-                "GL001", module.relpath, node.lineno, node.col_offset,
-                f"lock `{lock}` is held across blocking call `{label}`{via}; "
-                f"dispatching device programs or socket I/O inside a critical "
-                f"section risks deadlocking the collective rendezvous "
-                f"(PR 2) and stalls every other thread on the lock",
-                scope=module.scope_at(node)))
-            break  # one finding per with-statement is enough signal
+            for item in node.items:
+                lock = _lock_name(item.context_expr, definite)
+                if lock is None:
+                    continue
+                cls = _enclosing_class(module, info.index, node)
+                hit = program.find_reaching_call(
+                    info, list(node.body), cls,
+                    _scope_function(module, node), predicate)
+                if hit is None:
+                    continue
+                _, label, path = hit
+                via = " via " + " -> ".join(path[:-1]) if len(path) > 1 else ""
+                findings.append(Finding(
+                    "GL001", module.relpath, node.lineno, node.col_offset,
+                    f"lock `{lock}` is held across blocking call "
+                    f"`{label}`{via}; dispatching device programs or socket "
+                    f"I/O inside a critical section risks deadlocking the "
+                    f"collective rendezvous (PR 2) and stalls every other "
+                    f"thread on the lock",
+                    scope=module.scope_at(node)))
+                break  # one finding per with-statement is enough signal
     return findings
 
 
-def _nested_lock_edges(module: Module, index: callgraph.ModuleIndex,
-                       definite: Set[str]):
-    """(outer, inner, node) lock-acquisition edges: direct ``with`` nesting
-    plus one level of same-module call resolution."""
+def _lock_identity(program, info, expr_or_name, definite: Set[str]):
+    """The IDENTITY of a lock — ``(defining module relpath, name)`` — when
+    statically knowable, else None. A bare name is only comparable across
+    modules through its definition site: `_lock` in two unrelated modules
+    is two locks; `a_lock` imported by both from the same module is one."""
+    if isinstance(expr_or_name, str):
+        name = expr_or_name
+        sym = info.import_sym.get(name)
+        if sym is not None:
+            target = program.by_dotted.get(sym[0])
+            return ((target.relpath if target is not None else sym[0]),
+                    sym[1])
+        if name in definite:
+            return (info.relpath, name)
+        return None
+    if isinstance(expr_or_name, ast.Name):
+        return _lock_identity(program, info, expr_or_name.id, definite)
+    dotted = callgraph.dotted_name(expr_or_name)
+    if dotted is not None and dotted in definite:
+        return (info.relpath, dotted)
+    return None
+
+
+def _nested_lock_edges(program, info, definite: Set[str],
+                       definite_by_module: Dict[str, Set[str]]):
+    """(outer, inner, node, report_module) lock-acquisition edges: direct
+    ``with`` nesting plus one level of call resolution — now PROGRAM-wide,
+    so ``with a_lock: other_module.helper()`` sees the ``with b_lock:``
+    inside the helper. The finding stays anchored in the module holding the
+    outer lock (where the fix belongs); the inner module's definite-lock
+    and declared-order facts still apply."""
+    module = info.module
     edges = []
     for node in ast.walk(module.tree):
         if not isinstance(node, (ast.With, ast.AsyncWith)):
             continue
-        outers = [_lock_name(i.context_expr, definite) for i in node.items]
-        outers = [o for o in outers if o]
+        outers = [(
+            _lock_name(i.context_expr, definite),
+            _lock_identity(program, info, i.context_expr, definite))
+            for i in node.items]
+        outers = [(o, oid) for o, oid in outers if o]
         if not outers:
             continue
-        cls = _enclosing_class(module, index, node)
+        cls = _enclosing_class(module, info.index, node)
+        scope_fn = _scope_function(module, node)
+        local_types = program.local_types(info, scope_fn) \
+            if scope_fn is not None else {}
         # walk_executed: a `with B:` inside a def merely DEFINED under A is
         # deferred code — not an A->B acquisition.
-        inner_withs = [sub for body in node.body
+        inner_withs = [(sub, info) for body in node.body
                        for sub in callgraph.walk_executed(body)
                        if isinstance(sub, (ast.With, ast.AsyncWith))]
         for call in (c for body in node.body
                      for c in callgraph.calls_executed(body)):
-            target = index.resolve(call, cls)
-            if target is not None:
+            resolved = program.resolve_call(info, call, cls, local_types)
+            if resolved is not None:
                 inner_withs.extend(
-                    sub for stmt in target.body
+                    (sub, resolved.info) for stmt in resolved.fn.body
                     for sub in callgraph.walk_executed(stmt)
                     if isinstance(sub, (ast.With, ast.AsyncWith)))
-        for sub in inner_withs:
+        for sub, sub_info in inner_withs:
+            if sub_info is info:
+                sub_definite = definite
+            else:
+                sub_definite = definite_by_module.get(sub_info.relpath)
+                if sub_definite is None:
+                    sub_definite = _definite_locks(sub_info.module.tree)
+                    definite_by_module[sub_info.relpath] = sub_definite
             for item in sub.items:
-                inner = _lock_name(item.context_expr, definite)
+                inner = _lock_name(item.context_expr, sub_definite)
                 if inner is None:
                     continue
-                for outer in outers:
+                if sub_info is not info and not (
+                        isinstance(item.context_expr, ast.Name)
+                        and item.context_expr.id in sub_definite):
+                    # A foreign CLASS's instance-internal leaf lock
+                    # (metrics' per-instrument `self._lock`, the queue's
+                    # `self._cond`) is that module's encapsulated
+                    # discipline — its own intra-module pass orders it.
+                    # Cross-module edges track the callee's MODULE-GLOBAL
+                    # locks, where an inversion is two subsystems racing.
+                    continue
+                inner_id = _lock_identity(program, sub_info,
+                                          item.context_expr, sub_definite)
+                anchor = sub if sub_info is info else node
+                for outer, outer_id in outers:
                     if outer != inner:
-                        edges.append((outer, inner, sub))
+                        edges.append((outer, inner, anchor, sub_info,
+                                      outer_id, inner_id))
     return edges
 
 
-@register("GL002", "lock-order inversion / undeclared nesting")
-def check_lock_order(module: Module, ctx: Context) -> List[Finding]:
-    """GL002 — lock-order inversion.
+@register_program("GL002", "lock-order inversion / undeclared nesting")
+def check_lock_order(program, ctx: Context) -> List[Finding]:
+    """GL002 — lock-order inversion (interprocedural).
 
     Derives the acquisition order of named locks (direct ``with`` nesting
-    plus one level of same-module calls) and flags (a) any pair acquired in
-    both orders anywhere in the module — a classic ABBA deadlock — and
-    (b) any nested acquisition not covered by a declared order directive.
-    Declare the module's intended order once, next to the lock definitions:
+    plus one level of call resolution, including calls INTO OTHER MODULES
+    via the program call graph) and flags (a) any pair acquired in both
+    orders anywhere in the module — a classic ABBA deadlock — and (b) any
+    nested acquisition not covered by a declared order directive. Declare
+    the intended order once, next to the lock definitions:
 
         # graftlint: lock-order=_write_mutex->_lock
 
-    The directive is the machine-readable version of the prose rule
+    A cross-module edge honors the declaration in EITHER module involved
+    (the lock's home module is where its discipline is documented). The
+    directive is the machine-readable version of the prose rule
     ``staleness.ParameterService`` always had ("Order: _write_mutex ->
     _lock, never the reverse"); with it declared, a future path acquiring
     ``_lock`` then ``_write_mutex`` fails lint instead of deadlocking a
     production chief under load.
     """
-    if module.tree is None:
-        return []
     findings: List[Finding] = []
-    definite = _definite_locks(module.tree)
-    index = callgraph.ModuleIndex(module.tree)
-    declared = set(module.lock_orders)
-    seen: Dict[Tuple[str, str], ast.AST] = {}
-    reported: Set[Tuple[str, str, str]] = set()
-
-    for outer, inner, node in _nested_lock_edges(module, index, definite):
-        scope = module.scope_at(node)
-        if (outer, inner, scope) in reported:
+    definite_by_module: Dict[str, Set[str]] = {
+        info.relpath: _definite_locks(info.module.tree)
+        for info in program.modules()}
+    # Cross-module comparisons run on lock IDENTITY ((defining module,
+    # name) — resolved through imports), never on bare names: `_lock` in
+    # two unrelated modules is two locks, while `a_lock` two modules both
+    # import from a shared module is one. Two modules declaring (a, b) and
+    # (b, a) over the SAME identity pair — or acquiring one in opposite
+    # orders through each other's helpers — are two subsystems one
+    # scheduler decision away from deadlock. Same-module edges keep
+    # module-local name matching as before.
+    decls = []   # (relpath, a, b, id(a), id(b))
+    for info in program.modules():
+        definite = definite_by_module[info.relpath]
+        for a, b in sorted(set(info.module.lock_orders)):
+            decls.append((info.relpath, a, b,
+                          _lock_identity(program, info, a, definite),
+                          _lock_identity(program, info, b, definite)))
+    for rel, a, b, ida, idb in decls:
+        if ida is None or idb is None:
             continue
-        reported.add((outer, inner, scope))
-        if (inner, outer) in seen or (inner, outer) in declared:
-            findings.append(Finding(
-                "GL002", module.relpath, node.lineno, node.col_offset,
-                f"acquires `{inner}` while holding `{outer}`, conflicting "
-                f"with the established order `{inner}` -> `{outer}`; "
-                f"two threads taking these locks in opposite orders "
-                f"deadlock each other",
-                scope=scope))
-        elif (outer, inner) not in declared:
-            findings.append(Finding(
-                "GL002", module.relpath, node.lineno, node.col_offset,
-                f"nested lock acquisition `{outer}` -> `{inner}` has no "
-                f"declared order; add `# graftlint: "
-                f"lock-order={outer}->{inner}` at module level so future "
-                f"paths cannot silently invert it",
-                scope=scope))
-        seen.setdefault((outer, inner), node)
+        for rel2, a2, b2, ida2, idb2 in decls:
+            if rel2 > rel and (ida2, idb2) == (idb, ida):
+                findings.append(Finding(
+                    "GL002", rel2, 1, 0,
+                    f"declares lock-order `{a2}` -> `{b2}`, contradicting "
+                    f"{rel}'s declared `{a}` -> `{b}` over the same locks; "
+                    f"the two modules promise opposite acquisition orders "
+                    f"— one of the declarations (and its paths) must flip"))
+    cross_seen: Dict[Tuple[Tuple[str, str], Tuple[str, str]], str] = {}
+    for info in program.modules():
+        module = info.module
+        definite = definite_by_module[info.relpath]
+        declared = set(module.lock_orders)
+        seen: Dict[Tuple[str, str], ast.AST] = {}
+        reported: Set[Tuple[str, str, str]] = set()
+
+        for outer, inner, node, sub_info, outer_id, inner_id \
+                in _nested_lock_edges(program, info, definite,
+                                      definite_by_module):
+            scope = module.scope_at(node)
+            if (outer, inner, scope) in reported:
+                continue
+            reported.add((outer, inner, scope))
+            cross = sub_info is not info
+            edge_declared = declared if not cross \
+                else declared | set(sub_info.module.lock_orders)
+            if outer_id is not None and inner_id is not None:
+                # Program-wide ABBA runs on every identity-resolved edge
+                # (direct nestings of shared imported locks included, not
+                # just call-resolved ones), and is NOT exempted by a
+                # module's own-direction declaration: declaring your order
+                # does not make the other module's opposite acquisition
+                # safe — the conflict is the deadlock. Same-module
+                # inversions stay with the name-based per-module pass.
+                other = cross_seen.get((inner_id, outer_id))
+                if other is not None and other != module.relpath:
+                    findings.append(Finding(
+                        "GL002", module.relpath, node.lineno,
+                        node.col_offset,
+                        f"acquires `{inner}` while holding `{outer}`, but "
+                        f"{other} takes the same locks in the opposite "
+                        f"order — a program-wide ABBA deadlock across "
+                        f"modules",
+                        scope=scope))
+                cross_seen.setdefault((outer_id, inner_id), module.relpath)
+            if (inner, outer) in seen or (inner, outer) in edge_declared:
+                findings.append(Finding(
+                    "GL002", module.relpath, node.lineno, node.col_offset,
+                    f"acquires `{inner}` while holding `{outer}`, "
+                    f"conflicting with the established order `{inner}` -> "
+                    f"`{outer}`; two threads taking these locks in opposite "
+                    f"orders deadlock each other",
+                    scope=scope))
+            elif (outer, inner) not in edge_declared:
+                findings.append(Finding(
+                    "GL002", module.relpath, node.lineno, node.col_offset,
+                    f"nested lock acquisition `{outer}` -> `{inner}` has no "
+                    f"declared order; add `# graftlint: "
+                    f"lock-order={outer}->{inner}` at module level so future "
+                    f"paths cannot silently invert it",
+                    scope=scope))
+            seen.setdefault((outer, inner), node)
     return findings
 
 

@@ -43,6 +43,10 @@ instead of being misparsed as an absurd length. Sockets carrying a timeout
 always use the Python path to keep timeout semantics.
 """
 
+# The client's per-connection exchange lock nests the fault-injection
+# registry's module lock (testing/faults.py `_LOCK`, taken inside
+# `_faults.armed()`/`should_fire()`), never the reverse:
+# graftlint: lock-order=_lock->_LOCK
 import math
 import os
 import socket
@@ -92,6 +96,7 @@ def _native_transport():
     global _TR_LIB, _TR_FAILED
     if _TR_LIB is not None or _TR_FAILED:
         return _TR_LIB
+    # graftlint: disable=GL001(this lock EXISTS to serialize the one-time native compile — concurrent cc1 invocations over the same .so path corrupt the artifact; no device program or socket runs under it)
     with _TR_LOCK:
         if _TR_LIB is not None or _TR_FAILED:
             return _TR_LIB

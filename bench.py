@@ -645,25 +645,29 @@ def metrics_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
         _ = jax.device_get(loss)   # completion fence
         return n / (time.perf_counter() - t0)
 
-    measure(10)                    # compile + warmup
-    measure(log_every, boundary=True)   # warm the boundary path too
-    best = {"disabled": 0.0, "enabled": 0.0}
-    for _ in range(rounds):        # interleaved: load noise hits both sides
-        best["disabled"] = max(best["disabled"], measure(steps))
-        best["enabled"] = max(best["enabled"], measure(steps, boundary=True))
+    try:
+        measure(10)                    # compile + warmup
+        measure(log_every, boundary=True)   # warm the boundary path too
+        best = {"disabled": 0.0, "enabled": 0.0}
+        for _ in range(rounds):    # interleaved: load noise hits both sides
+            best["disabled"] = max(best["disabled"], measure(steps))
+            best["enabled"] = max(best["enabled"],
+                                  measure(steps, boundary=True))
 
-    # Direct boundary costs (min of rounds — load stretches, never shrinks).
-    sample_ms = render_ms = math.inf
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        hist.sample()
-        sample_ms = min(sample_ms, (time.perf_counter() - t0) * 1e3)
-        t0 = time.perf_counter()
-        text = openmetrics.render()
-        render_ms = min(render_ms, (time.perf_counter() - t0) * 1e3)
-    n_shards = len(hist.shards())
-    hist.close()
-    shutil.rmtree(tmp, ignore_errors=True)   # CI runs this every pass
+        # Direct boundary costs (min of rounds — load stretches, never
+        # shrinks).
+        sample_ms = render_ms = math.inf
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            hist.sample()
+            sample_ms = min(sample_ms, (time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            text = openmetrics.render()
+            render_ms = min(render_ms, (time.perf_counter() - t0) * 1e3)
+        n_shards = len(hist.shards())
+    finally:
+        hist.close()
+        shutil.rmtree(tmp, ignore_errors=True)   # CI runs this every pass
 
     step_ms = 1e3 / best["disabled"]
     overhead_pct = 100.0 * (sample_ms + render_ms) / log_every / step_ms
@@ -1020,10 +1024,12 @@ def serve_bench(requests: int = 32, clients: int = 8, max_batch: int = 4):
         # Warm every jitted program off the clock (one prefill per touched
         # bucket + decode + insert) through the full transport path.
         warm = serving.ServeClient(server.address)
-        for b in sorted({serving.bucket_for(len(p), engine.buckets)
-                         for p in prompts}):
-            warm.generate(np.arange(1, 1 + b, dtype=np.int32), 2)
-        warm.close()
+        try:
+            for b in sorted({serving.bucket_for(len(p), engine.buckets)
+                             for p in prompts}):
+                warm.generate(np.arange(1, 1 + b, dtype=np.int32), 2)
+        finally:
+            warm.close()
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=client_thread, args=(w,))
@@ -1525,50 +1531,55 @@ def selfheal_bench(steps_per_worker: int = 60, crash_at: int = 25,
         def drive(worker_id):
             worker = RemotePSWorker(addr, runner, worker_id=worker_id)
             i = 0
-            while i < steps_per_worker:
-                try:
-                    worker.step(batch_for(worker_id * 10_000 + i),
-                                timeout=120)
-                    i += 1
-                except faults.WorkerCrashed:
-                    crash_t["t"] = time.perf_counter()
-                    crash_t["applies"] = runner.service.updates_applied
-                    deadline = time.time() + 30
-                    while worker_id not in runner.controller._retired \
-                            and time.time() < deadline:
-                        time.sleep(0.005)
-                    # Bounded backoff, then the replacement registers and
-                    # catches up over read_min (RemotePSWorker.rejoin path
-                    # runs inside register+first pull).
-                    time.sleep(recovery.backoff_s(0, 0.05, cap_s=0.2))
-                    worker = RemotePSWorker(addr, runner,
-                                            worker_id=worker_id)
-            worker.close()
+            try:
+                while i < steps_per_worker:
+                    try:
+                        worker.step(batch_for(worker_id * 10_000 + i),
+                                    timeout=120)
+                        i += 1
+                    except faults.WorkerCrashed:
+                        crash_t["t"] = time.perf_counter()
+                        crash_t["applies"] = runner.service.updates_applied
+                        deadline = time.time() + 30
+                        while worker_id not in runner.controller._retired \
+                                and time.time() < deadline:
+                            time.sleep(0.005)
+                        # Bounded backoff, then the replacement registers
+                        # and catches up over read_min (the
+                        # RemotePSWorker.rejoin path runs inside
+                        # register+first pull).
+                        time.sleep(recovery.backoff_s(0, 0.05, cap_s=0.2))
+                        worker = RemotePSWorker(addr, runner,
+                                                worker_id=worker_id)
+            finally:
+                worker.close()
 
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=drive, args=(wid,))
-                   for wid in range(n_workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        dt = time.perf_counter() - t0
-        total = runner.service.updates_applied
-        post_rate = None
-        if crash and "t" in crash_t:
-            post_rate = (total - crash_t["applies"]) \
-                / max(1e-9, time.perf_counter() - crash_t["t"])
-        final = jax.device_get(runner.service.state.params)
-        # Leg-scoped recovery counts. NOTE: "evicted" includes the drive
-        # threads' clean-close disconnect retires, not just the crash — the
-        # REJOIN count is the fault-specific signal (only a retired slot's
-        # re-registration books one, and nothing in a clean leg retires
-        # before re-registering).
-        counts = recovery.recovery_snapshot()["counts"]
-        faults.clear()
-        server.close()
-        runner.close()
-        return total / dt, post_rate, total, final, counts
+        try:
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=drive, args=(wid,))
+                       for wid in range(n_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            total = runner.service.updates_applied
+            post_rate = None
+            if crash and "t" in crash_t:
+                post_rate = (total - crash_t["applies"]) \
+                    / max(1e-9, time.perf_counter() - crash_t["t"])
+            final = jax.device_get(runner.service.state.params)
+            # Leg-scoped recovery counts. NOTE: "evicted" includes the
+            # drive threads' clean-close disconnect retires, not just the
+            # crash — the REJOIN count is the fault-specific signal (only a
+            # retired slot's re-registration books one, and nothing in a
+            # clean leg retires before re-registering).
+            counts = recovery.recovery_snapshot()["counts"]
+            return total / dt, post_rate, total, final, counts
+        finally:
+            faults.clear()
+            server.close()
+            runner.close()
 
     run_leg(False)   # warmup: absorbs first-process costs (native build,
     #                  transport setup) so the two timed legs pay equally

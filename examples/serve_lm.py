@@ -78,11 +78,13 @@ def main(argv=None):
     # will touch, plus decode + insert — the printed p50/p99 measure
     # serving, not compilation.
     warm = serving.ServeClient(server.address)
-    for b in sorted({serving.bucket_for(len(p), engine.buckets)
-                     for p in prompts}):
-        if b + 2 <= args.max_len:   # a fuller bucket can't serve anyway
-            warm.generate(np.arange(1, 1 + b, dtype=np.int32), 2)
-    warm.close()
+    try:
+        for b in sorted({serving.bucket_for(len(p), engine.buckets)
+                         for p in prompts}):
+            if b + 2 <= args.max_len:   # a fuller bucket can't serve anyway
+                warm.generate(np.arange(1, 1 + b, dtype=np.int32), 2)
+    finally:
+        warm.close()
     timings, errors = [], []
     lock = threading.Lock()
 

@@ -42,6 +42,18 @@ def lint(tmp_path, source, relname="mod.py", checks=None, known_flags=None):
                            context=ctx)
 
 
+def lint_many(tmp_path, files, checks=None, known_flags=None):
+    """Lint a MULTI-FILE fixture tree (``{relname: source}``) as one
+    program — the cross-module ProgramIndex path."""
+    for relname, source in files.items():
+        path = tmp_path / relname
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    ctx = core.Context(str(tmp_path), known_flags=known_flags)
+    return core.lint_paths([str(tmp_path)], root=str(tmp_path),
+                           checks=checks, context=ctx)
+
+
 def codes(result):
     return [f.check for f in result.findings]
 
@@ -583,6 +595,762 @@ def test_gl008_bad_filename_and_unregistered_marker(tmp_path):
     assert "does not match" in msgs and "not registered" in msgs
 
 
+# ------------------------------------------- cross-module (ProgramIndex) lift
+
+def test_gl001_cross_module_lock_across_dispatch(tmp_path):
+    """The seeded acceptance fixture: a `with lock:` body that reaches a
+    socket send THROUGH ANOTHER MODULE — today's intra-module blind spot —
+    must fail lint, with the hop path named."""
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/sender.py": """
+            def push(sock, data):
+                sock.sendall(data)
+        """,
+        "pkg/locked.py": """
+            import threading
+
+            from pkg.sender import push
+
+            _lock = threading.Lock()
+
+            def locked_send(sock, data):
+                with _lock:
+                    push(sock, data)
+        """}, checks=["GL001"])
+    assert codes(res) == ["GL001"]
+    (f,) = res.findings
+    assert f.path == "pkg/locked.py"
+    assert "pkg.sender.push" in f.message and "sendall" in f.message
+
+
+def test_gl001_cross_module_via_module_attribute_and_instance(tmp_path):
+    """`mod.f()` chains and methods of locally-constructed imported-class
+    instances both resolve across the import."""
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/runner.py": """
+            class Runner:
+                def run(self, state, batch):
+                    return state
+        """,
+        "pkg/driver.py": """
+            import threading
+
+            from pkg.runner import Runner
+
+            _lock = threading.Lock()
+
+            def step(state, batch):
+                r = Runner()
+                with _lock:
+                    return r.run(state, batch)
+        """}, checks=["GL001"])
+    assert codes(res) == ["GL001"]
+    assert "run" in res.findings[0].message
+
+
+def test_gl001_cross_module_clean_when_callee_does_not_block(tmp_path):
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/helper.py": """
+            def tally(items):
+                return sum(items)
+        """,
+        "pkg/locked.py": """
+            import threading
+
+            from pkg.helper import tally
+
+            _lock = threading.Lock()
+
+            def locked_count(items):
+                with _lock:
+                    return tally(items)
+        """}, checks=["GL001"])
+    assert res.ok
+
+
+def test_gl002_cross_module_undeclared_nesting(tmp_path):
+    """A module-global lock acquired inside a helper ANOTHER module calls
+    under its own lock is an undeclared cross-module nesting."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/inner.py": """
+            import threading
+
+            _b_lock = threading.Lock()
+
+            def guarded():
+                with _b_lock:
+                    return 1
+        """,
+        "pkg/outer.py": """
+            import threading
+
+            from pkg.inner import guarded
+
+            _a_lock = threading.Lock()
+
+            def run():
+                with _a_lock:
+                    return guarded()
+        """}
+    res = lint_many(tmp_path, dict(files), checks=["GL002"])
+    assert codes(res) == ["GL002"]
+    assert res.findings[0].path == "pkg/outer.py"
+    assert "_a_lock` -> `_b_lock" in res.findings[0].message
+    # Declaring the order in EITHER module involved silences it.
+    files["pkg/outer.py"] = files["pkg/outer.py"].replace(
+        "import threading",
+        "# graftlint: lock-order=_a_lock->_b_lock\n"
+        "            import threading", 1)
+    assert lint_many(tmp_path, files, checks=["GL002"]).ok
+
+
+def test_gl002_cross_module_abba_on_shared_locks(tmp_path):
+    """Two modules importing the SAME lock pair from a shared module and
+    nesting them in opposite orders (through each other's helpers) is a
+    program-wide ABBA deadlock — identity-matched, so it fires."""
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": """
+            import threading
+
+            a_lock = threading.Lock()
+            b_lock = threading.Lock()
+
+            def take_b():
+                with b_lock:
+                    return 1
+
+            def take_a():
+                with a_lock:
+                    return 1
+        """,
+        "pkg/x.py": """
+            from pkg.locks import a_lock, take_b
+
+            def fx():
+                with a_lock:
+                    return take_b()
+        """,
+        "pkg/y.py": """
+            from pkg.locks import b_lock, take_a
+
+            def fy():
+                with b_lock:
+                    return take_a()
+        """}, checks=["GL002"])
+    assert any("program-wide ABBA" in f.message for f in res.findings)
+
+
+def test_gl002_same_names_in_unrelated_modules_are_distinct_locks(tmp_path):
+    """`_alpha_lock`/`_beta_lock` nested in opposite orders by two UNRELATED
+    module pairs are four distinct locks — identity matching must not
+    manufacture a program-wide ABBA (bare-name matching did)."""
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/b.py": """
+            import threading
+
+            _beta_lock = threading.Lock()
+
+            def helper():
+                with _beta_lock:
+                    return 1
+        """,
+        "pkg/a.py": """
+            # graftlint: lock-order=_alpha_lock->_beta_lock
+            import threading
+
+            from pkg import b
+
+            _alpha_lock = threading.Lock()
+
+            def fa():
+                with _alpha_lock:
+                    return b.helper()
+        """,
+        "pkg/d.py": """
+            import threading
+
+            _alpha_lock = threading.Lock()
+
+            def helper2():
+                with _alpha_lock:
+                    return 1
+        """,
+        "pkg/c.py": """
+            # graftlint: lock-order=_beta_lock->_alpha_lock
+            import threading
+
+            from pkg import d
+
+            _beta_lock = threading.Lock()
+
+            def fc():
+                with _beta_lock:
+                    return d.helper2()
+        """}, checks=["GL002"])
+    assert not any("ABBA" in f.message for f in res.findings)
+    assert not any("opposite acquisition orders" in f.message
+                   for f in res.findings)
+    assert res.ok   # declared orders cover both modules' own edges
+
+
+def test_gl001_deep_callee_reexplored_with_more_depth(tmp_path):
+    """Depth-aware cycle guard: a callee FIRST reached near the hop limit
+    (shallowly explored) must be re-explored when reached directly with
+    budget to spare — the finding must not depend on statement order."""
+    chain = "\n".join(
+        f"def f{i}(sock):\n    return f{i + 1}(sock)" for i in range(6))
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/deep.py": f"""
+{chain}
+
+def f6(sock):
+    return h(sock)
+
+def h(sock):
+    return g1(sock)
+
+def g1(sock):
+    return g2(sock)
+
+def g2(sock):
+    sock.sendall(b"x")
+""",
+        "pkg/locked.py": """
+            import threading
+
+            from pkg.deep import f0, h
+
+            _lock = threading.Lock()
+
+            def locked(sock):
+                with _lock:
+                    f0(sock)   # reaches h at the depth limit (shallow)
+                    h(sock)    # direct: must still find sendall
+        """}, checks=["GL001"])
+    assert codes(res) == ["GL001"]
+
+
+def test_gl002_direct_nesting_of_shared_locks_is_program_wide_abba(tmp_path):
+    """Two modules DIRECTLY nesting the same imported lock pair in
+    opposite orders (no call edge needed) is the simplest program-wide
+    ABBA — and a module's own-direction declaration must not vouch for
+    the other module's opposite acquisition."""
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": """
+            import threading
+
+            a_lock = threading.Lock()
+            b_lock = threading.Lock()
+        """,
+        "pkg/m1.py": """
+            from pkg.locks import a_lock, b_lock
+
+            def f1():
+                with b_lock:
+                    with a_lock:
+                        return 1
+        """,
+        "pkg/m2.py": """
+            # graftlint: lock-order=a_lock->b_lock
+            from pkg.locks import a_lock, b_lock
+
+            def f2():
+                with a_lock:
+                    with b_lock:
+                        return 2
+        """}
+    res = lint_many(tmp_path, files, checks=["GL002"])
+    assert any("program-wide ABBA" in f.message for f in res.findings)
+
+
+def test_gl002_contradictory_declarations_across_modules(tmp_path):
+    """Two modules PROMISING opposite orders for the SAME locks (same
+    identity: both import them from one home module) are two subsystems
+    one scheduler decision from deadlock — the program-wide declaration
+    cross-check catches what per-module matching cannot. Declarations
+    about unrelated same-named locks do not compare (identity-gated)."""
+    res = lint_many(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/x.py": """
+            # graftlint: lock-order=a_lock->b_lock
+            import threading
+
+            a_lock = threading.Lock()
+            b_lock = threading.Lock()
+        """,
+        "pkg/y.py": """
+            # graftlint: lock-order=b_lock->a_lock
+            from pkg.x import a_lock, b_lock
+        """}, checks=["GL002"])
+    assert codes(res) == ["GL002"]
+    assert "opposite acquisition orders" in res.findings[0].message
+
+
+# --------------------------------------------------------------------- GL009
+
+METRIC_PRODUCERS = """
+    from autodist_tpu import telemetry
+
+    def sample():
+        telemetry.gauge("train.mfu").set(0.5)
+        telemetry.counter("serve.requests.completed").inc()
+        for phase in ("compute", "comm"):
+            telemetry.gauge(f"train.attr.{phase}").set(0.1)
+"""
+
+# A fixture copy of alerts' DEFAULT_RULES shape: the acceptance scenario is
+# deleting a booked metric name (the producer above books train.mfu but NOT
+# train.attr.data_wait) and observing the dead-selector finding.
+ALERT_DEFAULTS = """
+    DEFAULT_RULES = [
+        {"name": "mfu_collapse", "kind": "drift", "metric": "train.mfu",
+         "ref_from": "window_max", "band": 0.5},
+        {"name": "data_wait_drift", "kind": "drift",
+         "metric": "train.attr.data_wait", "ref_from": "plan",
+         "band": 0.25},
+    ]
+"""
+
+
+def test_gl009_selector_with_no_producer_is_dead_on_arrival(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS.replace(
+            'f"train.attr.{phase}"', '"train.other"'),
+        "autodist_tpu/alerts.py": ALERT_DEFAULTS,
+    }, checks=["GL009"])
+    assert codes(res) == ["GL009"]
+    assert "train.attr.data_wait" in res.findings[0].message
+    assert "dead on arrival" in res.findings[0].message
+
+
+def test_gl009_fstring_producers_book_prefix_patterns(tmp_path):
+    """`f"train.attr.{phase}"` books `train.attr.*`, so the selector
+    resolves — and the whole fixture is clean."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS,
+        "autodist_tpu/alerts.py": ALERT_DEFAULTS,
+    }, checks=["GL009"])
+    assert res.ok
+
+
+def test_gl009_registry_lookup_of_unbooked_name(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS,
+        "tools/console.py": """
+            def render(reg):
+                good = reg.get("train.mfu")
+                bad = reg.get("train.mfuu")
+                return good, bad
+        """}, checks=["GL009"])
+    assert codes(res) == ["GL009"]
+    assert "train.mfuu" in res.findings[0].message
+
+
+def test_gl009_plan_phase_vocabulary(tmp_path):
+    """A ref_from='plan' drift rule whose phase suffix the plan never
+    prices degrades to a 0 reference — flagged against the breakdown-key
+    vocabulary harvested from the program."""
+    phase_map = """
+        def _reference(breakdown):
+            return {"compute": breakdown.get("compute_s", 0.0),
+                    "data_wait": breakdown.get("data_wait_s", 0.0)}
+    """
+    bad_rule = ALERT_DEFAULTS.replace("train.attr.data_wait",
+                                      "train.attr.datawait")
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS,
+        "autodist_tpu/ref.py": phase_map,
+        "autodist_tpu/alerts.py": bad_rule,
+    }, checks=["GL009"])
+    # The typo'd selector is BOTH unbooked (train.attr.* books it though —
+    # the pattern matches any suffix) and an unpriced phase.
+    assert codes(res) == ["GL009"]
+    assert "not a plan-priced phase" in res.findings[0].message
+
+
+def test_gl009_undocumented_package_metric(tmp_path):
+    (tmp_path / "docs" / "usage").mkdir(parents=True)
+    (tmp_path / "docs" / "usage" / "observability.md").write_text(
+        "Metrics: `train.mfu`, the `train.attr.*` family.\n")
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS,
+    }, checks=["GL009"])
+    assert codes(res) == ["GL009"]
+    assert "serve.requests.completed" in res.findings[0].message
+    assert "observability.md" in res.findings[0].message
+
+
+def test_gl009_wrapper_functions_and_defaults_book_names(tmp_path):
+    """One level of in-module wrapper forwarding and string parameter
+    defaults both contribute to the producer registry."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": """
+            from autodist_tpu import telemetry
+
+            def _counter(name):
+                return telemetry.counter(name)
+
+            def boot(metric_prefix="data"):
+                _counter("recover.evicted")
+                telemetry.gauge(f"{metric_prefix}.queue_depth").set(0)
+        """,
+        "tools/console.py": """
+            def render(reg):
+                return (reg.get("recover.evicted"),
+                        reg.get("data.queue_depth"))
+        """}, checks=["GL009"])
+    assert res.ok
+
+
+# --------------------------------------------------------------------- GL010
+
+CLOSEABLE_DEF = """
+    import threading
+
+    class Producer:
+        def __init__(self):
+            self._t = threading.Thread(target=lambda: None, daemon=True)
+
+        def close(self):
+            pass
+
+    def make_feed():
+        return Producer()
+"""
+
+
+def test_gl010_unclosed_closeable_leaks(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "examples/train.py": """
+            from autodist_tpu.res import make_feed
+
+            def main():
+                feed = make_feed()
+                for _ in range(3):
+                    next(feed)
+        """}, checks=["GL010"])
+    assert codes(res) == ["GL010"]
+    (f,) = res.findings
+    assert f.path == "examples/train.py" and "never closed" in f.message
+    assert "make_feed" in f.message   # the factory chain resolved
+
+
+def test_gl010_straight_line_close_is_unprotected(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "examples/train.py": """
+            from autodist_tpu.res import Producer
+
+            def main():
+                feed = Producer()
+                next(feed)
+                feed.close()
+        """}, checks=["GL010"])
+    assert codes(res) == ["GL010"]
+    assert "straight-line" in res.findings[0].message
+
+
+def test_gl010_clean_with_finally_with_block_or_escape(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "examples/train.py": """
+            from autodist_tpu.res import Producer, make_feed
+
+            def finally_path():
+                feed = make_feed()
+                try:
+                    next(feed)
+                finally:
+                    feed.close()
+
+            def with_path():
+                with Producer() as feed:
+                    next(feed)
+
+            def escapes_by_return():
+                feed = Producer()
+                return feed
+
+            def escapes_into_registry(registry):
+                feed = Producer()
+                registry.add(feed)
+        """}, checks=["GL010"])
+    assert res.ok
+
+
+def test_gl010_store_on_object_or_container_transfers_ownership(tmp_path):
+    """`self.x = feed` / `d[k] = feed` hand the resource to another owner
+    — the documented escape rule, not a leak."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "examples/train.py": """
+            from autodist_tpu.res import Producer
+
+            class Holder:
+                def attach(self):
+                    feed = Producer()
+                    self.feed = feed
+
+            def stash(feeds):
+                feed = Producer()
+                feeds["main"] = feed
+        """}, checks=["GL010"])
+    assert res.ok
+
+
+def test_gl009_test_fixture_producer_does_not_mask_dead_selector(tmp_path):
+    """A metric booked ONLY by a test must not keep a production alert
+    selector alive — producers are harvested from non-test code, symmetric
+    with the consumer exemption."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS.replace(
+            '"train.mfu"', '"train.mfu_v2"'),
+        "tests/test_old.py": """
+            from autodist_tpu import telemetry
+
+            def test_books_the_old_name():
+                telemetry.gauge("train.mfu").set(0.5)
+        """,
+        "autodist_tpu/alerts.py": ALERT_DEFAULTS,
+    }, checks=["GL009"])
+    assert [f.message for f in res.findings
+            if "train.mfu'" in f.message and "dead on arrival" in f.message]
+
+
+def test_changed_only_refuses_write_baseline(capsys):
+    assert cli.main(["--changed-only", "--write-baseline"]) == 2
+    assert "partial file set" in capsys.readouterr().err
+
+
+def test_partial_positional_paths_skip_registry_checks(capsys):
+    """Linting a subset must not report every shipped selector as dead
+    (GL009 over a partial producer set) — and must refuse to rewrite the
+    baseline from partial findings."""
+    rc = cli.main(["--no-cache", "autodist_tpu/telemetry/alerts.py",
+                   "tools/adtop.py"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out
+    assert "registry checks (GL009/GL011) skipped" in out.err
+    assert cli.main(["--no-cache", "--write-baseline",
+                     "autodist_tpu/telemetry/alerts.py"]) == 2
+    assert "partial path set" in capsys.readouterr().err
+
+
+def test_changed_only_refuses_pure_full_program_check_set(capsys):
+    """--changed-only --check GL009 would check NOTHING (the full-program
+    checks are skipped there) — error loudly instead of a silent green."""
+    assert cli.main(["--changed-only", "--check", "GL009"]) == 2
+    assert "would check NOTHING" in capsys.readouterr().err
+
+
+def test_gl009_doc_match_is_token_bounded(tmp_path):
+    """A booked `train.flops` must not count as documented because
+    `train.flops_per_s` appears in the doc's prose."""
+    (tmp_path / "docs" / "usage").mkdir(parents=True)
+    (tmp_path / "docs" / "usage" / "observability.md").write_text(
+        "The roofline gauge `train.flops_per_s` and the family "
+        "`serve.latency_s.*`.\n")
+    res = lint_many(tmp_path, {
+        "autodist_tpu/prod.py": """
+            from autodist_tpu import telemetry
+
+            def sample():
+                telemetry.gauge("train.flops").set(1.0)
+                telemetry.gauge("train.flops_per_s").set(1.0)
+                telemetry.histogram("serve.latency_s.total").observe(0.1)
+        """}, checks=["GL009"])
+    assert codes(res) == ["GL009"]
+    assert "'train.flops'" in res.findings[0].message
+
+
+def test_gl010_close_of_earlier_binding_does_not_cover_a_rebinding(tmp_path):
+    """Close-old-construct-new: the second Producer bound to the reused
+    name is its own resource — the earlier `with feed:` must not mark it
+    clean (position-sensitive tracing)."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "examples/train.py": """
+            from autodist_tpu.res import Producer
+
+            def main():
+                feed = Producer()
+                with feed:
+                    next(feed)
+                feed = Producer()
+                next(feed)
+        """}, checks=["GL010"])
+    assert codes(res) == ["GL010"]
+    assert res.findings[0].line == 8   # the REBINDING, not the first
+
+
+def test_gl010_class_attribute_construction_is_instance_state(tmp_path):
+    """`class Owner: feed = Feed()` is the class's state (closed through
+    the instance lifecycle, like `self.feed = ...`) — not a scope leak."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "autodist_tpu/owner.py": """
+            from autodist_tpu.res import Producer
+
+            class Owner:
+                feed = Producer()
+
+                def close(self):
+                    self.feed.close()
+        """}, checks=["GL010"])
+    assert res.ok
+
+
+def test_gl010_tests_are_exempt(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "tests/test_feed.py": """
+            from autodist_tpu.res import Producer
+
+            def test_leaky():
+                feed = Producer()
+                next(feed)
+        """}, checks=["GL010"])
+    assert res.ok
+
+
+# --------------------------------------------------------------------- GL011
+
+WIRE_MODULE = """
+    IDEMPOTENT_OPS = frozenset({"read", "version", "register"})
+
+    class PSClient:
+        def call_raw(self, msg, counters):
+            return msg
+
+        def call(self, *msg):
+            return self.call_raw(msg, None)
+
+    def _dispatch(msg):
+        op = msg[0]
+        if op == "read":
+            return ("ok", 1)
+        if op == "version":
+            return ("ok", 0)
+        if op == "register":
+            return ("ok",)
+        if op == "apply":
+            return ("ok",)
+        return ("error", "unknown")
+"""
+
+
+def test_gl011_cross_module_nonidempotent_raw_retry(tmp_path):
+    """The seeded acceptance fixture: a raw-path exchange in ANOTHER module
+    sending an op outside IDEMPOTENT_OPS — the register(None)-replay class
+    — fails lint."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/wiremod.py": WIRE_MODULE,
+        "autodist_tpu/overlap.py": """
+            from autodist_tpu.wiremod import PSClient
+
+            def prefetch(counters):
+                client = PSClient()
+                good = client.call_raw(("read", 0), counters)
+                bad = client.call_raw(("apply", 0), counters)
+                return good, bad
+        """}, checks=["GL011"])
+    assert codes(res) == ["GL011"]
+    (f,) = res.findings
+    assert f.path == "autodist_tpu/overlap.py"
+    assert "'apply'" in f.message and "IDEMPOTENT_OPS" in f.message
+
+
+def test_gl011_table_member_without_dispatch_arm(tmp_path):
+    # Typo the TABLE entry only (the dispatch arm keeps "register").
+    res = lint_many(tmp_path, {
+        "autodist_tpu/wiremod.py": WIRE_MODULE.replace(
+            '"register"})', '"regster"})'),
+    }, checks=["GL011"])
+    assert codes(res) == ["GL011"]
+    assert "'regster'" in res.findings[0].message
+
+
+def test_gl011_cross_module_send_without_any_arm(tmp_path):
+    """GL006 lifted: a `.call("op")` on a transport client in a module with
+    NO local `_dispatch` is checked against the program-wide arm union."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/wiremod.py": WIRE_MODULE,
+        "tools/console.py": """
+            from autodist_tpu.wiremod import PSClient
+
+            def fetch():
+                client = PSClient()
+                ok = client.call("version")
+                bad = client.call("stats")
+                return ok, bad
+        """}, checks=["GL011"])
+    assert codes(res) == ["GL011"]
+    assert "'stats'" in res.findings[0].message
+
+
+def test_gl011_unrelated_call_raw_method_is_not_a_wire_site(tmp_path):
+    """A class that merely NAMES a method call_raw is not a transport
+    client; its call sites are out of scope (receiver typing gates the
+    raw-path rule)."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/wiremod.py": WIRE_MODULE,
+        "autodist_tpu/mailbox.py": """
+            class Mailbox:
+                def call_raw(self, msg, prio):
+                    return msg
+
+            def post():
+                box = Mailbox()
+                return box.call_raw(("put", 1), 0)
+        """}, checks=["GL011"])
+    assert res.ok
+
+
+def test_gl011_annotated_parameter_receiver_is_typed(tmp_path):
+    """`client: PSClient` parameter annotations resolve cross-module — the
+    real overlapped-prefetch helper's shape stays covered."""
+    res = lint_many(tmp_path, {
+        "autodist_tpu/wiremod.py": WIRE_MODULE,
+        "autodist_tpu/overlap.py": """
+            from autodist_tpu.wiremod import PSClient
+
+            def exchange(client: PSClient, counters):
+                return client.call_raw(("record", "why"), counters)
+        """}, checks=["GL011"])
+    assert codes(res) == ["GL011"]
+    assert "'record'" in res.findings[0].message
+
+
+def test_gl011_clean_program(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/wiremod.py": WIRE_MODULE,
+        "autodist_tpu/overlap.py": """
+            from autodist_tpu.wiremod import PSClient
+
+            def prefetch(counters):
+                client = PSClient()
+                return client.call_raw(("read", 0), counters)
+        """}, checks=["GL011"])
+    assert res.ok
+
+
+def test_gl011_real_contract_is_joined():
+    """The real repo's table, arms and raw-path sites satisfy the joined
+    contract (the repo-wide gate asserts the same through the CLI; this
+    pins the specific check)."""
+    from autodist_tpu.parallel.ps_transport import IDEMPOTENT_OPS
+    assert "read_min" in IDEMPOTENT_OPS   # the overlapped raw-path op
+
+
 # ----------------------------------------------------------- engine behavior
 
 def test_reasonless_suppression_is_a_gl000_finding(tmp_path):
@@ -701,9 +1469,235 @@ def test_cli_explain_documents_real_bug_provenance(capsys):
     assert cli.main(["--explain", "GL999"]) == 2
 
 
-def test_all_eight_checks_are_registered():
-    ids = set(core.all_checks())
-    assert ids == {f"GL00{i}" for i in range(1, 9)}
+def test_all_eleven_checks_are_registered():
+    checks = core.all_checks()
+    assert set(checks) == {f"GL{i:03d}" for i in range(1, 12)}
+    # Interprocedural + registry checks run at program scope; the registry
+    # checks additionally need the COMPLETE path set to be sound.
+    assert {c for c, v in checks.items() if v.program} \
+        == {"GL001", "GL002", "GL009", "GL010", "GL011"}
+    assert {c for c, v in checks.items() if v.full_program} \
+        == {"GL009", "GL011"}
+
+
+# ------------------------------------------------------ cache / sarif / CLI
+
+def test_cache_program_warm_path_and_file_layer(tmp_path, capsys):
+    """Second identical run must hit the whole-program cache; touching one
+    file falls back to the per-file layer for the rest, with identical
+    findings either way."""
+    src_dir = tmp_path / "src"
+    (src_dir / "a.py").parent.mkdir(parents=True, exist_ok=True)
+    (src_dir / "a.py").write_text(textwrap.dedent(PR2_DEADLOCK))
+    (src_dir / "b.py").write_text("x = 1\n")
+    cache_dir = str(tmp_path / "cache")
+    ctx = core.Context(str(src_dir))
+
+    cache1 = core.LintCache(cache_dir)
+    res1 = core.lint_paths([str(src_dir)], root=str(src_dir), cache=cache1,
+                           checks=["GL001"], context=ctx)
+    assert codes(res1) == ["GL001"] and not cache1.program_hit
+
+    cache2 = core.LintCache(cache_dir)
+    res2 = core.lint_paths([str(src_dir)], root=str(src_dir), cache=cache2,
+                           checks=["GL001"], context=ctx)
+    assert cache2.program_hit
+    assert [f.fingerprint for f in res2.findings] \
+        == [f.fingerprint for f in res1.findings]
+
+    (src_dir / "b.py").write_text("y = 2\n")
+    cache3 = core.LintCache(cache_dir)
+    res3 = core.lint_paths([str(src_dir)], root=str(src_dir), cache=cache3,
+                           checks=["GL001"], context=ctx)
+    assert not cache3.program_hit
+    assert cache3.hits == 1 and cache3.misses == 1   # a.py reused, b.py re-run
+    assert [f.fingerprint for f in res3.findings] \
+        == [f.fingerprint for f in res1.findings]
+
+
+def test_cache_file_layer_invalidates_on_const_py_change(tmp_path):
+    """GL007 reads the flag registry from const.py — a flag deleted THERE
+    must invalidate every file's cached result, not just the program
+    layer (the per-file key hashes CACHE_EXTRA_INPUTS too)."""
+    src_dir = tmp_path / "src"
+    const = src_dir / "autodist_tpu" / "const.py"
+    const.parent.mkdir(parents=True)
+    const.write_text('KNOWN_FLAGS = {"%s": "doc"}\n' % GOOD_FLAG)
+    user = src_dir / "autodist_tpu" / "user.py"
+    user.write_text('import os\nf = os.environ.get("%s")\n' % GOOD_FLAG)
+    cache_dir = str(tmp_path / "cache")
+    res1 = core.lint_paths([str(user)], root=str(src_dir),
+                           cache=core.LintCache(cache_dir),
+                           checks=["GL007"],
+                           context=core.Context(str(src_dir)))
+    # The direct package read is flagged; the flag NAME is known (1 finding).
+    assert sum("unknown flag" in f.message for f in res1.findings) == 0
+    # Delete the flag's registration (another stays: an EMPTY registry
+    # disables the unknown-flag rule by design).
+    const.write_text('KNOWN_FLAGS = {"%s": "doc"}\n' % ("AUTODIST_" + "KEPT"))
+    cache2 = core.LintCache(cache_dir)
+    res2 = core.lint_paths([str(user)], root=str(src_dir), cache=cache2,
+                           checks=["GL007"],
+                           context=core.Context(str(src_dir)))
+    assert not cache2.program_hit and cache2.hits == 0
+    assert sum("unknown flag" in f.message for f in res2.findings) == 1
+
+
+def test_gl010_multi_target_closed_via_alias_is_clean(tmp_path):
+    res = lint_many(tmp_path, {
+        "autodist_tpu/res.py": CLOSEABLE_DEF,
+        "examples/train.py": """
+            from autodist_tpu.res import Producer
+
+            def main():
+                a = b = Producer()
+                try:
+                    next(a)
+                finally:
+                    b.close()
+        """}, checks=["GL010"])
+    assert res.ok
+
+
+def test_doc_text_refuses_unhashed_repo_inputs(tmp_path):
+    """A check reading a repo file the cache keys do not hash is a
+    structural bug — Context refuses it outright."""
+    ctx = core.Context(str(tmp_path))
+    assert ctx.doc_text("docs/usage/observability.md") is None   # absent: ok
+    with pytest.raises(ValueError, match="CACHE_EXTRA_INPUTS"):
+        ctx.doc_text("docs/usage/serving.md")
+
+
+def test_cache_program_layer_keeps_multiple_slots(tmp_path):
+    """A --check-subset run must not evict the full run's warm program
+    entry (the pre-commit --changed-only pattern)."""
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    (src_dir / "a.py").write_text(textwrap.dedent(PR2_DEADLOCK))
+    cache_dir = str(tmp_path / "cache")
+    core.lint_paths([str(src_dir)], root=str(src_dir),
+                    cache=core.LintCache(cache_dir), checks=["GL001"],
+                    context=core.Context(str(src_dir)))
+    # A different selection writes its own slot...
+    core.lint_paths([str(src_dir)], root=str(src_dir),
+                    cache=core.LintCache(cache_dir), checks=["GL002"],
+                    context=core.Context(str(src_dir)))
+    # ...and the original selection still hits warm.
+    cache3 = core.LintCache(cache_dir)
+    core.lint_paths([str(src_dir)], root=str(src_dir), cache=cache3,
+                    checks=["GL001"], context=core.Context(str(src_dir)))
+    assert cache3.program_hit
+
+
+def test_cache_prunes_entries_for_deleted_files(tmp_path):
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    a, b = src_dir / "a.py", src_dir / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("y = 2\n")
+    cache_dir = str(tmp_path / "cache")
+    core.lint_paths([str(src_dir)], root=str(src_dir),
+                    cache=core.LintCache(cache_dir), checks=["GL001"],
+                    context=core.Context(str(src_dir)))
+    b.unlink()
+    core.lint_paths([str(src_dir)], root=str(src_dir),
+                    cache=core.LintCache(cache_dir), checks=["GL001"],
+                    context=core.Context(str(src_dir)))
+    data = json.loads((tmp_path / "cache" / "cache.json").read_text())
+    assert "b.py" not in data["files"] and "a.py" in data["files"]
+
+
+def test_cache_invalidates_on_baseline_change_without_invalidation(tmp_path):
+    """Cached results are RAW (pre-baseline): grandfathering a finding
+    takes effect on a fully-warm cache run."""
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    (src_dir / "a.py").write_text(textwrap.dedent(PR2_DEADLOCK))
+    cache_dir = str(tmp_path / "cache")
+    ctx = core.Context(str(src_dir))
+    res1 = core.lint_paths([str(src_dir)], root=str(src_dir),
+                           cache=core.LintCache(cache_dir),
+                           checks=["GL001"], context=ctx)
+    baseline = {f.fingerprint for f in res1.findings}
+    cache2 = core.LintCache(cache_dir)
+    res2 = core.lint_paths([str(src_dir)], root=str(src_dir), cache=cache2,
+                           baseline=baseline, checks=["GL001"], context=ctx)
+    assert cache2.program_hit and res2.ok and len(res2.baselined) == 1
+
+
+def test_skip_full_program_drops_registry_checks_only(tmp_path):
+    """--changed-only's engine mode: GL009/GL011 (unsound on a partial
+    file set) are skipped; the interprocedural GL001 still runs."""
+    files = {
+        "autodist_tpu/prod.py": METRIC_PRODUCERS,
+        "autodist_tpu/alerts.py": ALERT_DEFAULTS.replace(
+            "train.mfu", "train.mfuu"),
+        "autodist_tpu/locked.py": PR2_DEADLOCK,
+    }
+    for relname, source in files.items():
+        path = tmp_path / relname
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    ctx = core.Context(str(tmp_path))
+    full = core.lint_paths([str(tmp_path)], root=str(tmp_path), context=ctx,
+                           checks=["GL001", "GL009"])
+    assert sorted(codes(full)) == ["GL001", "GL009"]
+    partial = core.lint_paths([str(tmp_path)], root=str(tmp_path),
+                              context=core.Context(str(tmp_path)),
+                              checks=["GL001", "GL009"],
+                              skip_full_program=True)
+    assert codes(partial) == ["GL001"]
+
+
+def test_changed_only_path_discovery():
+    """The git-derived path set is repo-relative .py files under the lint
+    roots (or None when git is unavailable) — the CLI falls back safely."""
+    changed = cli.changed_py_files()
+    assert changed is None or all(
+        p.endswith(".py") and not os.path.isabs(p) for p in changed)
+
+
+def test_sarif_output_round_trips(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(PR2_DEADLOCK))
+    rc = cli.main(["--format", "sarif", "--no-baseline", "--no-cache",
+                   "--check", "GL001", str(bad)])
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1 and sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    (result,) = run["results"]
+    assert result["ruleId"] == "GL001"
+    loc = result["locations"][0]["physicalLocation"]
+
+    # Round-trip: the SARIF location/message reproduces the JSON finding.
+    rc = cli.main(["--format", "json", "--no-baseline", "--no-cache",
+                   "--check", "GL001", str(bad)])
+    payload = json.loads(capsys.readouterr().out)
+    (finding,) = payload["findings"]
+    assert loc["artifactLocation"]["uri"] == finding["path"]
+    assert loc["region"]["startLine"] == finding["line"]
+    assert loc["region"]["startColumn"] == finding["col"] + 1
+    assert result["message"]["text"] == finding["message"]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {"GL001"}
+    # The SARIF run is clean-parseable as a whole-file JSON document and
+    # carries the schema pointer tools key on.
+    assert sarif["$schema"].endswith("sarif-schema-2.1.0.json")
+
+
+def test_json_output_reports_wall_time_and_cache(tmp_path, capsys):
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    rc = cli.main(["--format", "json", "--no-baseline", "--no-cache",
+                   str(good)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["wall_time_s"] >= 0
+    assert payload["cache"] == {"enabled": False}
+    rc = cli.main(["--format", "json", "--no-baseline",
+                   "--cache-dir", str(tmp_path / "c"), str(good)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["cache"]["enabled"] is True
 
 
 # ------------------------------------------------------------ self-cleanness
