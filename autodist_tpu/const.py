@@ -164,6 +164,21 @@ KNOWN_FLAGS = {
                        "tests/bench (testing/faults.py grammar: "
                        "'worker_crash@step=3,worker=1;nan_grads@step=5'); "
                        "empty = disarmed",
+    "AUTODIST_WIRE_DTYPE": "quantized PS gradient push: 'fp16', 'bf16' or "
+                           "'int8' compresses eligible gradient leaves on "
+                           "the wire (error feedback keeps convergence); "
+                           "empty/'off' = exact fp32 push. The autotuner's "
+                           "wire_dtype knob overrides when a tuned plan is "
+                           "applied",
+    "AUTODIST_COMPRESS_MIN_BYTES": "wire-compression size floor: gradient "
+                                   "leaves smaller than this (and all "
+                                   "vectors/scalars) bypass quantization "
+                                   "and push exact",
+    "AUTODIST_SPARSE_PUSH": "sparse top-k PS push: gradients of params the "
+                            "plan marks row-sparse (Parallax embeddings) "
+                            "ship as (row indices, touched rows) frames "
+                            "with server-side scatter-apply; '0' forces "
+                            "dense pushes",
     # Test/CI harness knobs (read by tests, tools/ and ci.sh, not the package).
     "AUTODIST_MATRIX_PROCS": "strategy-matrix process count (tests)",
     "AUTODIST_MATRIX_SINGLE": "strategy-matrix single-process leg (tests)",
@@ -317,6 +332,16 @@ _ENV_DEFAULTS = {
     "AUTODIST_WIRE_RETRIES": 2,
     "AUTODIST_WIRE_BACKOFF_S": 0.2,
     "AUTODIST_FAULTS": "",
+    # Wire-compression plane (parallel/synchronization.WirePushCompressor):
+    # quantized gradient pushes with error feedback plus sparse top-k pushes
+    # for row-sparse params. WIRE_DTYPE empty = exact pushes (the tuned
+    # plan's wire_dtype knob, when applied, takes precedence); the size
+    # floor keeps small leaves exact where scale bytes + host quantize cost
+    # would exceed the wire saving; SPARSE_PUSH defaults on because it is
+    # lossless (it only changes framing, never values).
+    "AUTODIST_WIRE_DTYPE": "",
+    "AUTODIST_COMPRESS_MIN_BYTES": 65536,
+    "AUTODIST_SPARSE_PUSH": True,
 }
 
 class ENV(enum.Enum):
@@ -379,6 +404,9 @@ class ENV(enum.Enum):
     AUTODIST_WIRE_RETRIES = "AUTODIST_WIRE_RETRIES"
     AUTODIST_WIRE_BACKOFF_S = "AUTODIST_WIRE_BACKOFF_S"
     AUTODIST_FAULTS = "AUTODIST_FAULTS"
+    AUTODIST_WIRE_DTYPE = "AUTODIST_WIRE_DTYPE"
+    AUTODIST_COMPRESS_MIN_BYTES = "AUTODIST_COMPRESS_MIN_BYTES"
+    AUTODIST_SPARSE_PUSH = "AUTODIST_SPARSE_PUSH"
 
     @property
     def val(self):

@@ -174,6 +174,13 @@ def _send_payload(sock: socket.socket,
     if total > _FRAME_LEN_MAX:
         raise wire.WireError(
             f"message of {total} bytes exceeds the 56-bit frame length")
+    if _faults.armed():
+        # Injected slow wire (bench.py --wire-compress): charge the payload
+        # at the installed bandwidth before it moves, both directions — the
+        # loopback stand-in for a congested pod fabric.
+        delay = _faults.throttle_s(total)
+        if delay > 0.0:
+            time.sleep(delay)   # bounded by the installed bytes_per_s
     # Native path only for plain blocking sockets (a socket timeout must keep
     # Python's timeout semantics, which raw-fd syscalls would bypass) and
     # single contiguous bytes payloads (the ctypes surface takes one buffer;
@@ -835,6 +842,20 @@ class PSServer:
             if op == "apply":
                 version = r.service.apply(msg[1])
                 return ("ok", version)
+            if op == "apply_sparse":
+                # Sparse-push apply: the wire codec already dequantized any
+                # quantized leaves; expand the SparseRows frames to dense
+                # (scatter rows into zeros — exact for the gather-only
+                # params the plan marks sparse) and run the ordinary apply.
+                from autodist_tpu.parallel.synchronization import \
+                    densify_sparse_rows
+                version = r.service.apply(densify_sparse_rows(msg[1]))
+                return ("ok", version)
+            if op == "wire_caps":
+                # Compression-capability probe: a pure read the compressing
+                # client sends once per connection; an old server answers
+                # "unknown op" and the client degrades to exact pushes.
+                return ("ok", {"quantized": True, "sparse_push": True})
             if op == "finish_step":
                 gen = r.controller.finish_step(msg[1])
                 return ("ok", gen)
@@ -931,14 +952,16 @@ class PSClientError(RuntimeError):
 #     its count); register(None) ALLOCATES a fresh slot per request, so a
 #     replay would leave a phantom live slot pinning min(steps) forever —
 #     _retry_safe carves it out;
-#   start_step — re-entering the gate wait moves no counters.
+#   start_step — re-entering the gate wait moves no counters;
+#   wire_caps — a pure capability read (no state touched).
 # NOT idempotent (a failure mid-exchange surfaces to the caller — the
 # request may or may not have landed, and replaying it would double-apply):
-#   apply (one gradient update), finish_step (advances the step count),
-#   record (writes a snapshot dir per request).
+#   apply / apply_sparse (one gradient update each — apply_sparse is apply
+#   with a densify prologue, same double-apply hazard), finish_step
+#   (advances the step count), record (writes a snapshot dir per request).
 IDEMPOTENT_OPS = frozenset({
     "read", "read_if_newer", "read_min", "version", "stats", "status",
-    "ping", "trace", "push_trace", "register", "start_step"})
+    "ping", "trace", "push_trace", "register", "start_step", "wire_caps"})
 
 
 def _retry_safe(msg) -> bool:
@@ -1143,18 +1166,40 @@ class RemotePSWorker:
     CLOCK_PING_ROUNDS = 7
 
     def __init__(self, address, runner, worker_id: int,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 wire_dtype: Optional[str] = None,
+                 compressor=None):
         self._client = _PSClient(address)
         self._runner = runner
         self.worker_id = worker_id
         self.steps_completed = 0
         self.last_version_read = -1
+        from autodist_tpu import const
         if overlap is None:
-            from autodist_tpu import const
             overlap = const.ENV.AUTODIST_PS_OVERLAP.val
         self._pull_client = _PSClient(address) if overlap else None
         self._prefetch: Optional[_Prefetch] = None
         self._server_has_read_min = True  # optimistic; cleared on unknown-op
+        # Wire-push compression: tuned plan's wire_dtype knob wins, then the
+        # env flag; sparse push rides for any plan that marks row-sparse
+        # params (lossless — framing only). ``compressor`` overrides
+        # everything (tests inject an EF-disabled one as negative control).
+        if compressor is None:
+            if wire_dtype is None:
+                wire_dtype = getattr(getattr(runner, "tuned_plan", None),
+                                     "wire_dtype", "") \
+                    or const.ENV.AUTODIST_WIRE_DTYPE.val
+            sparse_params = {}
+            plan = getattr(runner, "plan", None)
+            if const.ENV.AUTODIST_SPARSE_PUSH.val and plan is not None:
+                sparse_params = {
+                    name: p.index_leaf
+                    for name, p in plan.sparse_wire_params.items()}
+            from autodist_tpu.parallel.synchronization import \
+                WirePushCompressor
+            compressor = WirePushCompressor(wire_dtype,
+                                            sparse_params=sparse_params)
+        self._compressor = compressor if compressor.active else None
         # Chief-clock offset for this worker's main connection (estimated by
         # estimate_clock_offset; None until then). ADD to this process's
         # wall-clock ns to land on the chief's timeline.
@@ -1167,6 +1212,8 @@ class RemotePSWorker:
         # retired slot the live workers no longer wait for, silently making
         # the staleness bound one-sided.
         self.register()
+        if self._compressor is not None:
+            self._probe_wire_caps()
         # Cache of the last pulled (params, ef_state): the conditional pull in
         # step() reuses it when the service version is unchanged, so a worker
         # whose gate opened with no intervening applies ships no parameter
@@ -1187,6 +1234,30 @@ class RemotePSWorker:
     def wire_counters(self) -> WireCounters:
         """Full wire accounting (bytes/messages/codec time), consumed-basis."""
         return self._client.wire
+
+    def _probe_wire_caps(self):
+        """One ``wire_caps`` round trip: drop whichever compression regimes
+        the server cannot decode. An old server answers "unknown op" and
+        this worker degrades to exact pushes for its lifetime — the same
+        optimistic-capability pattern as ``_server_has_read_min``, probed
+        eagerly because a compressed frame an old server CAN'T decode would
+        fail its apply, not just fall back."""
+        try:
+            caps = self._client.call("wire_caps")[0] or {}
+        except PSClientError as e:
+            if "unknown op" not in str(e):
+                raise
+            caps = {}
+            logging.warning(
+                "PS worker %s: server has no wire_caps op; pushing exact "
+                "uncompressed gradients", self.worker_id)
+        comp = self._compressor
+        if not caps.get("quantized"):
+            comp.wire_dtype = ""
+        if not caps.get("sparse_push"):
+            comp.sparse_params = {}
+        if not comp.active:
+            self._compressor = None
 
     def register(self) -> int:
         """(Re-)admit this worker to the chief's staleness gate — the elastic
@@ -1383,8 +1454,19 @@ class RemotePSWorker:
         # finish/start gate round trips. The gate ordering is unchanged —
         # finish_step goes out only after the apply is acknowledged.
         self._start_prefetch()
+        push_op = "apply"
+        if self._compressor is not None:
+            # Host-side compression between grad materialization and the
+            # push: quantize (+ error-feedback residual), sparse-frame any
+            # row-sparse params. The server's decode dequantizes and
+            # apply_sparse densifies, so its apply path sees a dense tree.
+            with telemetry.span("ps.compress", worker=self.worker_id):
+                grads, has_sparse = self._compressor.compress(grads,
+                                                              batch=batch)
+            if has_sparse:
+                push_op = "apply_sparse"
         with telemetry.span("ps.push", worker=self.worker_id):
-            self._client.call("apply", grads)
+            self._client.call(push_op, grads)
             self._client.call("finish_step", self.worker_id)
         self.steps_completed += 1
         if r.has_aux:

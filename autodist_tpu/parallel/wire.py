@@ -11,6 +11,12 @@ format covering exactly the protocol's value vocabulary —
 - numpy ndarrays as ``dtype name + shape + raw C-order bytes`` (the typed
   tensor framing; custom float dtypes like bfloat16 ride as their true dtype
   name, decoded via ml_dtypes),
+- QUANTIZED tensors (:class:`QuantizedArray`, tag ``q``) as ``original dtype
+  + payload dtype + shape + float32 scale section + raw low-precision
+  bytes`` — the compressed gradient-push framing. The scale section holds
+  one per-tensor scale or one scale PER ROW (int8 2-D grads). Decode
+  DEQUANTIZES: the receiver gets a plain dense ndarray of the original
+  dtype, so a server's apply path never learns the push was compressed,
 - REGISTERED dataclass pytree nodes (compressor state such as ``EFState``),
   encoded as a registry key + field dict and reconstructed only through the
   registry — never by importing attacker-chosen names.
@@ -41,7 +47,8 @@ from typing import Any, Callable, Dict, List, Tuple
 import numpy as np
 
 __all__ = ["encode", "encode_parts", "decode", "register_wire_dataclass",
-           "WireError"]
+           "WireError", "QuantizedArray", "quantize", "dequantize",
+           "WIRE_DTYPES"]
 
 
 class WireError(ValueError):
@@ -72,6 +79,121 @@ def register_wire_dataclass(cls: type, key: str = None) -> type:
     _REGISTRY[key] = (cls, tuple(f.name for f in dataclasses.fields(cls)))
     _CLS_KEY[cls] = key
     return cls
+
+
+# ------------------------------------------------------------------- quantized
+
+# The wire dtypes the compression plane speaks. "fp16"/"bf16" halve the
+# payload; "int8" quarters it (plus a 4-byte scale per row for 2-D grads).
+WIRE_DTYPES = ("fp16", "bf16", "int8")
+
+
+def _wire_np_dtype(wire_dtype: str) -> np.dtype:
+    if wire_dtype == "fp16":
+        return np.dtype(np.float16)
+    if wire_dtype == "bf16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    if wire_dtype == "int8":
+        return np.dtype(np.int8)
+    raise ValueError(f"unknown wire dtype {wire_dtype!r}; valid: "
+                     f"{', '.join(WIRE_DTYPES)}")
+
+
+class QuantizedArray:
+    """A host tensor carried in low precision on the wire (tag ``q``).
+
+    ``qdata`` is the low-precision payload in the ORIGINAL shape; ``scale``
+    is a float32 vector of dequantization multipliers — size 1 (per-tensor)
+    or size ``shape[0]`` (per-row, the int8 framing for 2-D+ gradients,
+    where one outlier row must not crush every other row's resolution);
+    ``dtype`` is the original dtype the decoder restores. Built by
+    :func:`quantize`; the decoder never sees this class — ``decode``
+    dequantizes in place of constructing it."""
+
+    __slots__ = ("qdata", "scale", "dtype")
+
+    def __init__(self, qdata, scale, dtype):
+        self.qdata = np.asarray(qdata)
+        self.scale = np.ascontiguousarray(
+            np.asarray(scale, np.float32).reshape(-1))
+        self.dtype = np.dtype(dtype)
+        rows = self.qdata.shape[0] if self.qdata.ndim else 1
+        if self.scale.size not in (1, rows):
+            raise WireError(
+                f"quantized array: {self.scale.size} scales for {rows} rows "
+                f"(want 1 or {rows})")
+
+    @property
+    def shape(self):
+        return self.qdata.shape
+
+    @property
+    def wire_nbytes(self) -> int:
+        """Payload bytes this frame ships (scales + quantized data) — what
+        ``ps.wire.bytes_saved`` accounting compares against the dense size."""
+        return self.qdata.nbytes + self.scale.nbytes
+
+
+def quantize(arr, wire_dtype: str) -> QuantizedArray:
+    """Quantize a float host array for the wire.
+
+    int8 is symmetric: ``q = round(x / s)`` with the stored ``s`` the
+    DEQUANT multiplier ``amax / 127`` — per row (axis 0) for 2-D+ arrays
+    whose rows span >= 8 elements, per tensor otherwise (narrower rows
+    cannot amortize a 4-byte f32 scale each: a (N, 1) grad would grow past
+    its own float32 encoding); an all-zero row stores scale 0 and payload 0.
+    fp16 stores a per-tensor scale that is 1.0 unless the tensor overflows
+    float16's range (then ``amax / 65504``); bf16 is a pure cast (same
+    exponent range as float32, scale stays 1.0)."""
+    arr = np.asarray(arr)
+    if not np.issubdtype(arr.dtype, np.floating):
+        raise WireError(f"cannot quantize non-float dtype {arr.dtype}")
+    x = arr.astype(np.float32, copy=False)
+    if wire_dtype == "int8":
+        if x.size == 0:
+            q = np.zeros(x.shape, np.int8)
+            nrows = x.shape[0] if x.ndim else 1
+            return QuantizedArray(q, np.zeros(max(1, nrows), np.float32),
+                                  arr.dtype)
+        if x.ndim >= 2 and x.size // x.shape[0] >= 8:
+            amax = np.max(np.abs(x), axis=tuple(range(1, x.ndim)),
+                          keepdims=True)
+        else:
+            amax = np.max(np.abs(x)).reshape((1,) * x.ndim)
+        scale = (amax / 127.0).astype(np.float32)
+        safe = np.where(scale > 0.0, scale, np.float32(1.0))
+        q = np.clip(np.rint(x / safe), -127.0, 127.0).astype(np.int8)
+        return QuantizedArray(q, scale.reshape(-1), arr.dtype)
+    qdtype = _wire_np_dtype(wire_dtype)
+    amax = float(np.max(np.abs(x))) if x.size else 0.0
+    scale = 1.0
+    if wire_dtype == "fp16" and amax > 65504.0:
+        scale = amax / 65504.0
+    q = (x / np.float32(scale)).astype(qdtype) if scale != 1.0 \
+        else x.astype(qdtype)
+    return QuantizedArray(q, np.array([scale], np.float32), arr.dtype)
+
+
+def _dequantize_raw(q, scale, dtype: np.dtype) -> np.ndarray:
+    """Shared dequant core: always returns a FRESH writable dense array of
+    ``dtype`` (``q.astype`` copies — the q payload may alias a recycled
+    receive buffer, the result never does)."""
+    x = np.asarray(q).astype(np.float32)
+    scale = np.asarray(scale, np.float32).reshape(-1)
+    if scale.size == 1:
+        if scale[0] != 1.0:
+            x *= scale[0]
+    else:
+        x *= scale.reshape((-1,) + (1,) * max(0, x.ndim - 1))
+    return np.ascontiguousarray(x.astype(dtype, copy=False))
+
+
+def dequantize(qa: QuantizedArray) -> np.ndarray:
+    """Reconstruct the dense array a :func:`quantize` frame represents —
+    the exact values a peer's ``decode`` would hand its apply path (the
+    error-feedback residual is ``x - dequantize(quantize(x))``)."""
+    return _dequantize_raw(qa.qdata, qa.scale, qa.dtype)
 
 
 # ---------------------------------------------------------------------- encode
@@ -147,6 +269,27 @@ def _enc(out, obj: Any):
         out += b"b"
         out += _u64.pack(len(obj))
         out += obj
+    elif type(obj) is QuantizedArray:
+        # Quantized frame: orig dtype + payload dtype + shape + scale
+        # section + raw low-precision bytes. Same borrow rule as tag "a":
+        # the (large) payload rides as a zero-copy view under encode_parts.
+        q = obj.qdata
+        out += b"q"
+        _enc_str(out, str(obj.dtype))
+        _enc_str(out, str(q.dtype))
+        out += bytes([q.ndim])
+        for d in q.shape:
+            out += _u64.pack(d)
+        out += _u32.pack(obj.scale.size)
+        out += obj.scale.tobytes()
+        if (type(out) is _PartSink and q.nbytes >= _BORROW_MIN_BYTES
+                and q.flags.c_contiguous):
+            out += _u64.pack(q.nbytes)
+            out.borrow(memoryview(q.reshape(-1).view(np.uint8)))
+        else:
+            raw = q.tobytes()
+            out += _u64.pack(len(raw))
+            out += raw
     elif isinstance(obj, (np.ndarray, np.generic)):
         # asarray, NOT ascontiguousarray: the latter promotes 0-d to 1-d,
         # silently reshaping scalar gradients. tobytes() below serializes in
@@ -313,6 +456,28 @@ def _dec(r: _Reader) -> Any:
             # tree cannot scribble over a recycled buffer.
             flat.flags.writeable = False
         return flat.view(dtype).reshape(shape)
+    if tag == b"q":
+        dtype = _np_dtype(r.str_())
+        qdtype = _np_dtype(r.str_())
+        ndim = bytes(r.take(1))[0]
+        shape = tuple(r.u64() for _ in range(ndim))
+        nscales = r.u32()
+        rows = shape[0] if ndim else 1
+        if nscales not in (1, rows):
+            raise WireError(f"quantized frame: {nscales} scales for {rows} "
+                            f"row(s) (want 1 or {rows})")
+        scale = np.frombuffer(r.take(4 * nscales), np.float32)
+        nbytes = r.u64()
+        want = int(np.prod(shape, dtype=np.int64)) * qdtype.itemsize
+        if nbytes != want:
+            raise WireError(
+                f"quantized payload {nbytes}B != shape/dtype {want}B")
+        q = np.frombuffer(r.take(nbytes), np.uint8).view(qdtype).reshape(shape)
+        # Dequantize-on-decode: the apply path receives a plain dense array
+        # of the original dtype. Dequantization allocates fresh memory, so
+        # this frame never aliases the receive buffer in EITHER copy mode —
+        # the copy flag only governs tag "a".
+        return _dequantize_raw(q, scale, dtype)
     if tag == b"t":
         return tuple(_dec(r) for _ in range(r.u32()))
     if tag == b"l":

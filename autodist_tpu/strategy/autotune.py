@@ -89,9 +89,20 @@ PRUNE_MARGIN = 0.35
 # - wire_bytes_per_s 400e6: the measured zero-copy PS wire rate
 #   (PERF_BASELINE ps_wire zero_copy, MB/s) — the comm term for async-PS
 #   candidates.
+# - quantize_bytes_per_s 2e9: numpy's per-row int8 quantize rate on a
+#   CPU-class host (abs-max reduce + scale + round over the dense bytes) —
+#   the host-cost term that makes wire_dtype a priced trade instead of a
+#   free win; calibrate() refits it from any compressed run's profile.
 DEFAULT_CALIBRATION = costmodel.Calibration(
     flops_per_s=5e10, bytes_per_s=5e9, host_s_per_dispatch=2e-3,
-    wire_bytes_per_s=400e6)
+    wire_bytes_per_s=400e6, quantize_bytes_per_s=2e9)
+
+# The wire_dtype knob's enumeration axis for async-PS candidates, and each
+# value's push-byte compression ratio (int8 is 1/4 payload + ~2% per-row
+# float32 scales). The ratio prices bytes only; the host quantize seconds
+# are priced separately over quantize_bytes_per_s.
+DEFAULT_WIRE_DTYPES = ("", "fp16", "int8")
+_WIRE_RATIO = {"": 1.0, "fp16": 0.5, "bf16": 0.5, "int8": 0.26}
 
 # Builders the autotuner may emit, by name — the reconstructible subset a
 # cached plan can name (cache entries store a spec, not a pickle).
@@ -133,6 +144,7 @@ class Candidate:
     zero: int = 0
     overlap: bool = True                  # async-PS prefetch client knob
     prefetch_depth: int = 0               # input-pipeline prefetch knob
+    wire_dtype: str = ""                  # quantized-push knob ("" = exact)
     asynchronous: bool = False            # async regime: predicted, not probed
     why: str = ""                         # enumeration reason
     predicted: Optional[Dict[str, Any]] = None   # costmodel.predict output
@@ -150,6 +162,8 @@ class Candidate:
             knobs.append(f"zero={self.zero}")
         if self.prefetch_depth:
             knobs.append(f"pf={self.prefetch_depth}")
+        if self.wire_dtype:
+            knobs.append(f"wire={self.wire_dtype}")
         if self.asynchronous:
             knobs.append("async" + ("" if self.overlap else ",overlap=0"))
         base = self.builder_spec["name"]
@@ -160,11 +174,11 @@ class Candidate:
 
     def base_key(self) -> Tuple:
         """The compile-probe grouping key: candidates differing only in
-        ``unroll``/``overlap``/``prefetch_depth`` share one probed base
-        program (the fused block's cost is the scanned body's x K — the
-        same scaling rule the runner's cost extraction already applies —
-        and the prefetch producer changes the host pipeline, not the
-        compiled program)."""
+        ``unroll``/``overlap``/``prefetch_depth``/``wire_dtype`` share one
+        probed base program (the fused block's cost is the scanned body's
+        x K — the same scaling rule the runner's cost extraction already
+        applies — and the prefetch producer and the wire-push compressor
+        both change the host pipeline, not the compiled program)."""
         return (self.builder_spec["name"],
                 tuple(sorted((self.builder_spec.get("kwargs") or {}).items())),
                 self.accumulation_steps, self.zero, self.asynchronous)
@@ -184,6 +198,7 @@ class TunedPlan:
     zero: int = 0
     overlap: bool = True
     prefetch_depth: int = 0
+    wire_dtype: str = ""
     predicted: Optional[Dict[str, Any]] = None
     measured_steps_per_s: Optional[float] = None
     cache_key: str = ""
@@ -201,14 +216,16 @@ class TunedPlan:
         c = Candidate(self.builder_spec, unroll=self.unroll,
                       accumulation_steps=self.accumulation_steps,
                       zero=self.zero, overlap=self.overlap,
-                      prefetch_depth=self.prefetch_depth)
+                      prefetch_depth=self.prefetch_depth,
+                      wire_dtype=self.wire_dtype)
         return c.name
 
     def knobs_dict(self) -> Dict[str, Any]:
         return {"builder": self.builder_spec, "unroll": self.unroll,
                 "accumulation_steps": self.accumulation_steps,
                 "zero": self.zero, "overlap": self.overlap,
-                "prefetch_depth": self.prefetch_depth}
+                "prefetch_depth": self.prefetch_depth,
+                "wire_dtype": self.wire_dtype}
 
     def to_dict(self) -> Dict[str, Any]:
         """The cache entry / profile-manifest record: knobs + prediction +
@@ -234,6 +251,7 @@ class TunedPlan:
                    zero=int(knobs.get("zero") or 0),
                    overlap=bool(knobs.get("overlap", True)),
                    prefetch_depth=int(knobs.get("prefetch_depth") or 0),
+                   wire_dtype=str(knobs.get("wire_dtype") or ""),
                    predicted=d.get("predicted"),
                    measured_steps_per_s=d.get("measured_steps_per_s"),
                    cache_key=d.get("cache_key") or "",
@@ -272,7 +290,8 @@ class TunedPlan:
                         and c.unroll == self.unroll
                         and c.accumulation_steps == self.accumulation_steps
                         and c.zero == self.zero
-                        and c.prefetch_depth == self.prefetch_depth):
+                        and c.prefetch_depth == self.prefetch_depth
+                        and c.wire_dtype == self.wire_dtype):
                     tail += "  <- winner"
             elif c.probe is not None:
                 tail = f"probe: {c.probe.error}"
@@ -404,7 +423,9 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
                          include_async: Optional[bool] = None,
                          budget: Optional[int] = None,
                          prefetch_depths: Optional[Sequence[int]] = None,
-                         loader_s_per_step: float = 0.0) -> List[Candidate]:
+                         loader_s_per_step: float = 0.0,
+                         wire_dtypes: Optional[Sequence[str]] = None,
+                         ) -> List[Candidate]:
     """The joint candidate space, generated from :class:`AutoStrategy`'s
     analytic rules instead of collapsed to its one answer:
 
@@ -421,7 +442,9 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
       (only where the mesh has a data-parallel extent to shard over), and
       ``prefetch_depth`` (sync only; enumerated only when the tuning
       problem declares a loader cost — ``loader_s_per_step > 0`` — since
-      without one every depth predicts identically).
+      without one every depth predicts identically), and ``wire_dtype``
+      (async only — the quantized-push knob prices wire bytes against
+      host quantize seconds, a trade that exists only across the PS wire).
 
     Deterministic order (builder priority, then unroll/accum/zero
     ascending), capped at ``budget`` (``AUTODIST_TUNE_BUDGET``) with a log
@@ -479,6 +502,8 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
     if prefetch_depths is None:
         prefetch_depths = DEFAULT_PREFETCH_DEPTHS \
             if loader_s_per_step > 0 else (0,)
+    if wire_dtypes is None:
+        wire_dtypes = DEFAULT_WIRE_DTYPES
     out: List[Candidate] = []
     for spec, is_async, why in bases:
         for accum in accums:
@@ -493,10 +518,12 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
                     if zero:
                         continue
                     for overlap in (True, False):
-                        out.append(Candidate(
-                            spec, unroll=1, accumulation_steps=accum,
-                            zero=0, overlap=overlap, asynchronous=True,
-                            why=why))
+                        for wire_dtype in wire_dtypes:
+                            out.append(Candidate(
+                                spec, unroll=1, accumulation_steps=accum,
+                                zero=0, overlap=overlap,
+                                wire_dtype=wire_dtype, asynchronous=True,
+                                why=why))
                     continue
                 for unroll in unrolls:
                     for depth in prefetch_depths:
@@ -549,17 +576,28 @@ def _load_calibration(
     return DEFAULT_CALIBRATION, "bundled-default"
 
 
-def _comm_bytes_per_step(model_spec, cand: Candidate) -> float:
-    """The PS-wire bytes one optimizer step moves for an async candidate:
-    a param pull + a gradient push (~2x dense param bytes); the overlapped
-    client hides the pull behind compute, leaving ~the push. Sync
-    candidates cross no host wire — their collectives live inside the
-    compiled program's own cost analysis."""
+def _wire_terms(model_spec, cand: Candidate) -> Tuple[float, float]:
+    """``(comm_bytes, quantize_bytes)`` one optimizer step charges an async
+    candidate. The two wire DIRECTIONS are priced separately because only
+    the push compresses: push = dense gradient bytes x the candidate's
+    ``wire_dtype`` ratio; pull = dense param bytes, exact, hidden entirely
+    when the overlapped client prefetches it behind compute. (The
+    calibrated rate is per-direction-symmetric — see
+    ``costmodel._wire_bytes_per_s`` — so scaling each direction's byte
+    count before summing is the correct composition; scaling the lumped
+    2x total by the push ratio would discount the incompressible pull.)
+    ``quantize_bytes`` is the DENSE bytes the host must quantize per step
+    (the cost side of the trade), zero for exact pushes. Sync candidates
+    cross no host wire — their collectives live inside the compiled
+    program's own cost analysis."""
     if not cand.asynchronous:
-        return 0.0
-    dense_bytes = sum(s.byte_size for s in model_spec.trainable.values()
-                     if not s.sparse)
-    return float(dense_bytes if cand.overlap else 2 * dense_bytes)
+        return 0.0, 0.0
+    dense_bytes = float(sum(s.byte_size for s in model_spec.trainable.values()
+                            if not s.sparse))
+    push = dense_bytes * _WIRE_RATIO.get(cand.wire_dtype, 1.0)
+    pull = 0.0 if cand.overlap else dense_bytes
+    quantize = dense_bytes if cand.wire_dtype else 0.0
+    return push + pull, quantize
 
 
 def _derive_record(base: Dict[str, Any], unroll: int) -> Dict[str, Any]:
@@ -727,11 +765,13 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                     c.pruned = str(base)
                     continue
                 rec = _derive_record(base, c.unroll)
+                comm_bytes, quantize_bytes = _wire_terms(model_spec, c)
                 c.predicted = costmodel.predict(
                     rec, calib,
-                    comm_bytes_per_step=_comm_bytes_per_step(model_spec, c),
+                    comm_bytes_per_step=comm_bytes,
                     loader_s_per_step=loader_s_per_step,
-                    prefetch_depth=c.prefetch_depth)
+                    prefetch_depth=c.prefetch_depth,
+                    quantize_bytes_per_step=quantize_bytes)
         predicted = [c for c in cands if c.predicted is not None]
         if not predicted:
             raise RuntimeError(
@@ -809,6 +849,7 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
         builder_spec=winner.builder_spec, unroll=winner.unroll,
         accumulation_steps=winner.accumulation_steps, zero=winner.zero,
         overlap=winner.overlap, prefetch_depth=winner.prefetch_depth,
+        wire_dtype=winner.wire_dtype,
         predicted=winner.predicted,
         measured_steps_per_s=winner.probe.steps_per_sec, cache_key=key,
         search_s=time.perf_counter() - t_start, enumerated=len(cands),

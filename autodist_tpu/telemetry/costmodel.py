@@ -35,12 +35,18 @@ class Calibration:
     sustained during the profiled run's compute phase — NOT hardware peaks;
     ``host_s_per_dispatch`` is the host-side cost of one program launch
     (feed sharding + enqueue); ``wire_bytes_per_s`` is the measured PS-wire
-    bandwidth (None for collective-only runs)."""
+    bandwidth (None for collective-only runs); ``quantize_bytes_per_s`` is
+    the host's achieved gradient quantize rate (dense bytes in per second
+    of ``wire.quantize_s``), fitted like ``host_s_per_dispatch`` — the cost
+    side of wire compression, so :func:`predict` can refuse a wire_dtype
+    whose quantize seconds exceed the wire seconds it saves (None until a
+    compressed run has been profiled)."""
 
     flops_per_s: Optional[float] = None
     bytes_per_s: Optional[float] = None
     host_s_per_dispatch: float = 0.0
     wire_bytes_per_s: Optional[float] = None
+    quantize_bytes_per_s: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -55,7 +61,19 @@ def _wire_bytes_per_s(profile: Dict[str, Any]) -> Optional[float]:
     """Measured PS-wire bandwidth: the profile's ``wire`` block (the
     ``ps.wire.*`` registry counters ``profile_document`` attaches when the
     run mirrored any transport traffic) over the comm phase's wall seconds;
-    None for collective-only runs, which cross no wire."""
+    None for collective-only runs, which cross no wire.
+
+    SYMMETRIC-RATE ASSUMPTION, deliberate: ``bytes_sent + bytes_received``
+    are lumped over ONE comm window, i.e. the fitted rate models a
+    full-duplex link whose send and receive directions achieve the same
+    bandwidth (true of the loopback and NIC fabrics this transport runs
+    on; the overlapped client moves pull traffic off the comm window's
+    critical path anyway). Callers that price an ASYMMETRICALLY compressed
+    plan — a quantized push against an uncompressed pull — must therefore
+    scale the per-DIRECTION byte counts before dividing by this rate
+    (``strategy/autotune._wire_terms`` prices push and pull separately for
+    exactly this reason); scaling the lumped total by the push ratio would
+    skew the prediction by the pull share."""
     wire = profile.get("wire") or {}
     total_bytes = (wire.get("bytes_sent", 0) or 0) \
         + (wire.get("bytes_received", 0) or 0)
@@ -64,6 +82,18 @@ def _wire_bytes_per_s(profile: Dict[str, Any]) -> Optional[float]:
     comm_s = (shares.get("comm") or 0.0) * (summary.get("wall_s") or 0.0)
     if total_bytes and comm_s > 0:
         return total_bytes / comm_s
+    return None
+
+
+def _quantize_bytes_per_s(profile: Dict[str, Any]) -> Optional[float]:
+    """Achieved host quantize rate: dense gradient bytes the compressor
+    consumed (``ps.wire.bytes_quantized``) over its cumulative
+    ``wire.quantize_s``; None when the profiled run never compressed."""
+    wire = profile.get("wire") or {}
+    qbytes = wire.get("bytes_quantized", 0) or 0
+    qs = wire.get("quantize_s", 0.0) or 0.0
+    if qbytes and qs > 0:
+        return qbytes / qs
     return None
 
 
@@ -93,6 +123,7 @@ def calibrate(profile: Dict[str, Any]) -> Calibration:
         if bytes_step and steps and compute_s > 0 else None,
         host_s_per_dispatch=summary.get("host_s_per_dispatch") or 0.0,
         wire_bytes_per_s=_wire_bytes_per_s(profile),
+        quantize_bytes_per_s=_quantize_bytes_per_s(profile),
     )
 
 
@@ -100,7 +131,8 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
             calib: Calibration,
             comm_bytes_per_step: float = 0.0,
             loader_s_per_step: float = 0.0,
-            prefetch_depth: int = 0) -> Dict[str, Any]:
+            prefetch_depth: int = 0,
+            quantize_bytes_per_step: float = 0.0) -> Dict[str, Any]:
     """Predict per-step time for a candidate plan's program set.
 
     ``plan_costs``: one program-cost dict or an iterable of them — the
@@ -113,6 +145,14 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
     ``calib.host_s_per_dispatch`` for the launch; ``comm_bytes_per_step``
     over the calibrated wire bandwidth adds the PS transfer term.
 
+    ``comm_bytes_per_step`` must already reflect any wire compression (the
+    caller scales the push direction by its compression ratio — see the
+    ``_wire_bytes_per_s`` direction note); ``quantize_bytes_per_step`` is
+    the DENSE bytes the compressor must quantize per step, priced over
+    ``calib.quantize_bytes_per_s`` as host seconds — the cost side of the
+    trade, so compression only predicts faster when the wire seconds saved
+    exceed the quantize seconds added.
+
     ``loader_s_per_step`` prices the input pipeline: with
     ``prefetch_depth == 0`` (the synchronous feed) the loader's full
     per-step seconds land in the step; with ``prefetch_depth >= 1`` the
@@ -123,8 +163,9 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
     depth >= 1 sustains ``max(rest_s, loader_s)`` per step.
 
     Returns ``{"step_s", "steps_per_s", "bound", "breakdown": {compute_s,
-    memory_s, host_s, comm_s, data_wait_s per step}}`` — ``bound`` names
-    the binding resource, the MLPerf-style "what do I fix first" answer."""
+    memory_s, host_s, comm_s, quantize_s, data_wait_s per step}}`` —
+    ``bound`` names the binding resource, the MLPerf-style "what do I fix
+    first" answer."""
     if isinstance(plan_costs, dict):
         plan_costs = [plan_costs]
     compute_s = memory_s = device_s = 0.0
@@ -146,7 +187,11 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
     comm_s = 0.0
     if comm_bytes_per_step and calib.wire_bytes_per_s:
         comm_s = comm_bytes_per_step / calib.wire_bytes_per_s
-    hidden_s = device_s / total_steps + host_s / total_steps + comm_s
+    quantize_s = 0.0
+    if quantize_bytes_per_step and calib.quantize_bytes_per_s:
+        quantize_s = quantize_bytes_per_step / calib.quantize_bytes_per_s
+    hidden_s = device_s / total_steps + host_s / total_steps + comm_s \
+        + quantize_s
     data_s = 0.0
     if loader_s_per_step > 0:
         data_s = max(0.0, loader_s_per_step - hidden_s) \
@@ -156,11 +201,13 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
                  "memory_s": memory_s / total_steps,
                  "host_s": host_s / total_steps,
                  "comm_s": comm_s,
+                 "quantize_s": quantize_s,
                  "data_wait_s": data_s}
     bound = max(("compute", breakdown["compute_s"]),
                 ("memory", breakdown["memory_s"]),
                 ("host", breakdown["host_s"]),
                 ("comm", breakdown["comm_s"]),
+                ("quantize", breakdown["quantize_s"]),
                 ("data_wait", breakdown["data_wait_s"]),
                 key=lambda kv: kv[1])[0] if step_s > 0 else "unknown"
     return {"step_s": step_s,

@@ -582,9 +582,16 @@ def profile_document(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     # from these + the comm share — the interconnect term of predict().
     snap = _metrics.snapshot()
     wire = {key: snap[f"ps.wire.{key}"] for key in
-            ("bytes_sent", "bytes_received")
+            ("bytes_sent", "bytes_received", "bytes_saved",
+             "bytes_quantized")
             if isinstance(snap.get(f"ps.wire.{key}"), (int, float))
             and snap[f"ps.wire.{key}"] > 0}
+    # The compressor's host seconds live under its own wire.* prefix (it is
+    # not transport traffic); calibrate's quantize_bytes_per_s fit reads
+    # bytes_quantized / quantize_s out of this same block.
+    qs = snap.get("wire.quantize_s")
+    if isinstance(qs, (int, float)) and qs > 0:
+        wire["quantize_s"] = qs
     if wire:
         doc["wire"] = wire
     if extra:

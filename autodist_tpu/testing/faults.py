@@ -26,6 +26,11 @@ kind               instrumented site                           effect
 ``wire_reset``     ``_PSClient.call_raw`` (keyed by ``op``)    socket closed +
                                                                ``ConnectionResetError``
                                                                before the send
+``wire_slow``      ``ps_transport._send_payload``              payload sends
+                                                               throttled to
+                                                               ``bytes_per_s``
+                                                               (sleep before
+                                                               send)
 =================  ==========================================  =============
 
 Spec grammar (``AUTODIST_FAULTS`` or :func:`install`): semicolon-separated
@@ -54,10 +59,10 @@ from autodist_tpu.utils import logging
 
 __all__ = ["FaultPoint", "WorkerCrashed", "KINDS", "parse", "install",
            "clear", "armed", "should_fire", "hang_s", "corrupt_batch",
-           "points"]
+           "points", "throttle_s"]
 
 KINDS = ("worker_crash", "worker_hang", "nan_grads", "wire_refuse",
-         "wire_reset")
+         "wire_reset", "wire_slow")
 
 
 class WorkerCrashed(RuntimeError):
@@ -78,6 +83,7 @@ class FaultPoint:
     op: Optional[str] = None        # wire opcode (wire_reset)
     count: int = 1                  # firings before the point is spent
     for_s: float = 0.0              # hang duration (worker_hang)
+    bytes_per_s: float = 0.0        # injected wire bandwidth (wire_slow)
     fired: int = 0
 
     def __post_init__(self):
@@ -100,7 +106,7 @@ class FaultPoint:
 
 
 _INT_KEYS = ("step", "worker", "count")
-_FLOAT_KEYS = ("for_s",)
+_FLOAT_KEYS = ("for_s", "bytes_per_s")
 
 
 def parse(spec: str) -> List[FaultPoint]:
@@ -220,6 +226,22 @@ def hang_s(step: Optional[int] = None,
                 logging.warning("faults: hanging worker %s at step %s for "
                                 "%.3fs", worker, step, p.for_s)
                 return max(0.0, float(p.for_s))
+    return 0.0
+
+
+def throttle_s(nbytes: int) -> float:
+    """Seconds a ``wire_slow`` point charges a payload of ``nbytes`` — the
+    injected-bandwidth model behind ``bench.py --wire-compress``. Unlike the
+    discrete faults this does NOT consume a firing: a bandwidth is a
+    standing condition, not an event (``count`` is ignored; ``clear()``
+    lifts it). The caller sleeps — the harness never parks a thread."""
+    plan = _PLAN
+    if plan is None:
+        return 0.0
+    with _LOCK:
+        for p in plan:
+            if p.kind == "wire_slow" and p.bytes_per_s > 0:
+                return nbytes / p.bytes_per_s
     return 0.0
 
 

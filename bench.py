@@ -1641,6 +1641,136 @@ def selfheal_bench(steps_per_worker: int = 60, crash_at: int = 25,
     return result
 
 
+def wire_compress_bench(steps: int = 30, rounds: int = 3, dim: int = 512,
+                        out_dim: int = 512, bytes_per_s: float = 25e6):
+    """Priced wire-compression gate: loopback async-PS training under an
+    injected slow wire (the ``wire_slow`` fault point throttles every
+    ``_send_payload`` to ``bytes_per_s``), exact pushes vs int8+EF
+    compressed pushes, best of ``rounds`` interleaved rounds. The gated
+    numbers in the PERF_BASELINE.json ``wire_compress`` row:
+
+    - ``compressed_vs_exact``: compressed steps/s must be >=
+      ``min_ratio`` (1.2) x exact — under a wire-bound run the 4x push-byte
+      cut must buy real throughput, not just smaller counters;
+    - ``bytes_saved`` must be > 0 and agree with the dense-minus-wire
+      accounting (the same ``ps.wire.bytes_saved`` counter adtop/adfleet
+      render and the cost model's ``quantize_bytes_per_s`` fit reads);
+    - both legs' final params stay finite (EF keeps the compressed run a
+      faithful optimizer, not a faster diverging one).
+
+    The same trade the autotuner prices: on a fast wire the quantize
+    seconds are NOT paid back (tests pin that it declines); this bench
+    injects the slow-wire regime where compression must win."""
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.parallel.ps_transport import PSServer, RemotePSWorker
+    from autodist_tpu.parallel.synchronization import WirePushCompressor
+    from autodist_tpu.strategy import PS
+    from autodist_tpu.testing import faults
+
+    platform = jax.devices()[0].platform
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(dim, out_dim).astype(np.float32)
+    batch = {"x": rng.randn(32, dim).astype(np.float32)}
+    batch["y"] = batch["x"] @ w_true
+
+    def loss_fn(p, b):
+        return jnp.mean((b["y"] - b["x"] @ p["w"]) ** 2)
+
+    dense_bytes = dim * out_dim * 4
+
+    def run_leg(wire_dtype):
+        """One timed leg: a fresh loopback session, throttled wire, and an
+        explicitly injected compressor (exact = inactive)."""
+        ad = AutoDist(strategy_builder=PS(sync=False))
+        runner = ad.create_distributed_session(
+            loss_fn, {"w": np.zeros((dim, out_dim), np.float32)},
+            optax.sgd(0.01), example_batch=batch, num_workers=1)
+        runner.init({"w": np.zeros((dim, out_dim), np.float32)})
+        server = PSServer(runner, host="127.0.0.1", watchdog=False)
+        comp = WirePushCompressor(wire_dtype, min_bytes=1024)
+        worker = RemotePSWorker("%s:%d" % server.address, runner,
+                                worker_id=0, overlap=False, compressor=comp)
+        try:
+            worker.warmup(batch)
+            faults.install(f"wire_slow@bytes_per_s={bytes_per_s}")
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                worker.step(batch, timeout=120)
+            dt = time.perf_counter() - t0
+            final = jax.device_get(runner.service.state.params)
+            finite = all(np.isfinite(np.asarray(l)).all()
+                         for l in jax.tree_util.tree_leaves(final))
+            return steps / dt, comp, finite
+        finally:
+            faults.clear()
+            worker.close()
+            server.close()
+            runner.close()
+
+    run_leg("")   # warmup leg: first-process transport/compile costs
+    exact_rate, int8_rate = 0.0, 0.0
+    comp = None
+    finite_all = True
+    for _ in range(rounds):   # interleaved best-of: load noise hits both
+        r, _, f1 = run_leg("")
+        exact_rate = max(exact_rate, r)
+        r, c, f2 = run_leg("int8")
+        if r > int8_rate:
+            int8_rate, comp = r, c
+        finite_all = finite_all and f1 and f2
+
+    ratio = int8_rate / exact_rate if exact_rate else 0.0
+    result = {
+        "metric": f"wire_compress ({platform}, loopback async-PS, "
+                  f"{dim}x{out_dim} f32 grads ({dense_bytes // 1024} KiB "
+                  f"dense), wire throttled to "
+                  f"{bytes_per_s / 1e6:.0f} MB/s, {steps} steps, best of "
+                  f"{rounds})",
+        "unit": "steps/s",
+        "rows": {"exact": round(exact_rate, 2),
+                 "int8_ef": round(int8_rate, 2)},
+        "compressed_vs_exact": round(ratio, 4),
+        "bytes_saved": comp.bytes_saved,
+        "bytes_saved_per_step": comp.bytes_saved // steps,
+        "finite_params": finite_all,
+    }
+    if comp.bytes_saved <= 0 \
+            or comp.bytes_saved != comp.bytes_in - comp.bytes_out:
+        print("WARNING: bytes_saved accounting is inconsistent "
+              f"(in {comp.bytes_in}, out {comp.bytes_out}, saved "
+              f"{comp.bytes_saved}) — the compressor's counters no longer "
+              "mean dense-minus-wire", file=sys.stderr)
+    if not finite_all:
+        print("WARNING: a leg's final params are not finite — compression "
+              "corrupted the optimizer trajectory", file=sys.stderr)
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("wire_compress")
+        if recorded and recorded.get("platform") == platform:
+            floor = recorded.get("min_ratio", 1.2)
+            if ratio < floor:
+                print(f"WARNING: compressed push is {ratio:.2f}x the exact "
+                      f"steps/s under the injected slow wire, below the "
+                      f"{floor:.2f}x floor — compression stopped paying for "
+                      f"its quantize cost (see PERF_BASELINE.json "
+                      f"wire_compress)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["int8_ef"],
+                        "unit": "steps/s",
+                        "compressed_vs_exact": result["compressed_vs_exact"],
+                        "bytes_saved": result["bytes_saved"]})
+    return result
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -1729,6 +1859,14 @@ def main(argv=None):
              "PERF_BASELINE.json (run completes with finite params; "
              "post-eviction steps/s >= min_ratio x fault-free)")
     parser.add_argument(
+        "--wire-compress", action="store_true",
+        help="measure the priced wire-compression path: loopback async-PS "
+             "training under an injected slow wire (wire_slow fault point), "
+             "exact pushes vs int8+error-feedback compressed pushes, gated "
+             "against the wire_compress row in PERF_BASELINE.json "
+             "(compressed >= min_ratio x exact steps/s, bytes_saved "
+             "accounting consistent, finite params both legs)")
+    parser.add_argument(
         "--autotune", action="store_true",
         help="run the plan autotuner's full predict-prune-probe search on "
              "the CPU micro-model and gate the winner: tuned plan steps/s "
@@ -1770,6 +1908,9 @@ def main(argv=None):
         return
     if args.selfheal:
         selfheal_bench()
+        return
+    if args.wire_compress:
+        wire_compress_bench()
         return
     if args.autotune:
         autotune_bench()

@@ -152,6 +152,11 @@ python bench.py --data-plane
 # completing on finite params at >= min_ratio of the fault-free steps/s
 # after the eviction point (selfheal row).
 python bench.py --selfheal
+# Priced wire-compression gate: under an injected slow wire, int8+EF
+# compressed pushes must beat exact pushes by min_ratio steps/s with
+# consistent dense-minus-wire bytes_saved accounting and finite params
+# (wire_compress row).
+python bench.py --wire-compress
 # Plan-autotuner gate: the predict-prune-probe search must measure at most
 # top-k of the enumerated candidates and its winner must not lose to the
 # default plan (autotune row: tuned/default >= min_ratio).

@@ -235,3 +235,124 @@ def test_overlapped_client_matches_serial_client():
             remote.close()
             server.close()
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
+
+
+# ------------------------------------------------------------ quantized frames
+
+def _q_header_len(msg: bytes) -> int:
+    """Byte offset of the u32 nscales field in a top-level tag-``q`` frame:
+    tag + two length-prefixed dtype strings + ndim byte + u64 dims."""
+    off = 1
+    for _ in range(2):
+        (n,) = struct.unpack_from("!I", msg, off)
+        off += 4 + n
+    ndim = msg[off]
+    return off + 1 + 8 * ndim
+
+
+@pytest.mark.parametrize("wire_dtype", wire.WIRE_DTYPES)
+def test_quantized_roundtrip_both_faces(wire_dtype):
+    """A quantized frame is byte-identical across encode/encode_parts, and
+    BOTH copy modes decode it to the same fresh dense array (dequantize-on-
+    decode: the receiver sees exactly ``dequantize(qa)``, original dtype)."""
+    rng = np.random.RandomState(7)
+    x = (rng.randn(32, 48) * 3).astype(np.float32)
+    qa = wire.quantize(x, wire_dtype)
+    expect = wire.dequantize(qa)
+    assert expect.dtype == x.dtype and expect.shape == x.shape
+    flat = wire.encode(qa)
+    parts = wire.encode_parts(qa)
+    assert b"".join(bytes(p) for p in parts) == flat
+    buf = bytearray(flat)
+    for copy in (True, False):
+        got = wire.decode(memoryview(buf), copy=copy)
+        assert isinstance(got, np.ndarray) and got.dtype == x.dtype
+        np.testing.assert_array_equal(got, expect)
+        # Never aliases the receive buffer in either mode: writable + the
+        # source bytes can be scribbled without the decoded value moving.
+        assert got.flags.writeable
+        got[0, 0] = -1.0
+
+
+def test_quantized_int8_per_row_scales_bound_error():
+    """int8 2-D grads carry one scale PER ROW, so an outlier row cannot
+    crush another row's resolution: each row's error stays <= scale/2."""
+    x = np.ones((3, 64), np.float32)
+    x[0] *= 1e4        # outlier row
+    x[1] *= 1e-3       # tiny row — would round to 0 under a tensor scale
+    x[2] = 0.0         # all-zero row stores scale 0, payload 0
+    qa = wire.quantize(x, "int8")
+    assert qa.scale.size == 3
+    deq = wire.dequantize(qa)
+    for i in range(3):
+        assert np.max(np.abs(deq[i] - x[i])) <= qa.scale[i] / 2 + 1e-12
+    assert qa.scale[2] == 0.0 and np.all(deq[2] == 0.0)
+    # A 1-D gradient gets one per-tensor scale.
+    assert wire.quantize(np.ones(1000, np.float32), "int8").scale.size == 1
+
+
+def test_quantized_payload_borrowed_by_encode_parts():
+    """The low-precision payload rides as a zero-copy view of the qdata
+    array's own memory under encode_parts (same borrow rule as tag ``a``)."""
+    x = np.random.randn(64, 1024).astype(np.float32)
+    qa = wire.quantize(x, "int8")       # 64 KiB payload, >= borrow floor
+    parts = wire.encode_parts(qa)
+    borrowed = [p for p in parts if isinstance(p, memoryview)]
+    assert len(borrowed) == 1 and borrowed[0].nbytes == qa.qdata.nbytes
+    # And the frame really is smaller than the dense encoding.
+    assert len(wire.encode(qa)) < len(wire.encode(x)) / 3
+
+
+def test_quantized_malformed_frames_rejected():
+    x = np.random.randn(16, 16).astype(np.float32)
+    msg = wire.encode(wire.quantize(x, "int8"))
+    off = _q_header_len(msg)
+    # Truncations through the scale section and the payload.
+    (nscales,) = struct.unpack_from("!I", msg, off)
+    for cut in (off + 2, off + 4 + 4 * nscales - 1, len(msg) - 1):
+        with pytest.raises(wire.WireError):
+            wire.decode(msg[:cut])
+    # A scale count that is neither 1 nor rows.
+    bad = bytearray(msg)
+    struct.pack_into("!I", bad, off, 5)
+    with pytest.raises(wire.WireError):
+        wire.decode(bytes(bad))
+    # Payload length disagreeing with shape/dtype.
+    bad = bytearray(msg)
+    struct.pack_into("!Q", bad, off + 4 + 4 * nscales, 999)
+    with pytest.raises(wire.WireError):
+        wire.decode(bytes(bad))
+    # Building the frame with a bad scale vector fails at construction.
+    with pytest.raises(wire.WireError):
+        wire.QuantizedArray(np.zeros((4, 4), np.int8),
+                            np.zeros(3, np.float32), np.float32)
+
+
+def test_sparse_rows_roundtrip_and_densify():
+    """The row-sparse push frame (indices + rows + dense shape) round-trips
+    byte-identically through both faces, and server-side densify scatters
+    EXACTLY — duplicate indices accumulate."""
+    from autodist_tpu.parallel.synchronization import (SparseRows,
+                                                       densify_sparse_rows)
+
+    rng = np.random.RandomState(3)
+    sp = SparseRows(indices=np.array([2, 7, 2], np.int64),
+                    rows=rng.randn(3, 5).astype(np.float32),
+                    shape=(10, 5))
+    flat = wire.encode({"emb": sp})
+    parts = wire.encode_parts({"emb": sp})
+    assert b"".join(bytes(p) for p in parts) == flat
+    got = wire.decode(flat)["emb"]
+    assert isinstance(got, SparseRows)
+    np.testing.assert_array_equal(got.indices, sp.indices)
+    np.testing.assert_array_equal(got.rows, sp.rows)
+    assert tuple(got.shape) == (10, 5)
+    dense = densify_sparse_rows({"emb": got})["emb"]
+    expect = np.zeros((10, 5), np.float32)
+    np.add.at(expect, sp.indices, sp.rows)
+    np.testing.assert_array_equal(dense, expect)
+    assert np.count_nonzero(np.abs(dense).sum(axis=1)) == 2
+    # Truncated index/row sections are rejected like any malformed frame.
+    for cut in (len(flat) // 3, len(flat) - 2):
+        with pytest.raises(wire.WireError):
+            wire.decode(flat[:cut])

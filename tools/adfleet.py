@@ -79,6 +79,15 @@ def _fmt_q(value) -> str:
     return f"{value * 1e3:.0f}ms" if value is not None else "-"
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
 def _row(address: str, status: dict) -> str:
     from autodist_tpu.telemetry import metrics as _metrics
     if status.get("error") and "kind" not in status:
@@ -112,6 +121,12 @@ def _row(address: str, status: dict) -> str:
     if active:
         cols.append("ALERT " + ",".join(sorted(a.get("rule", "?")
                                                for a in active)))
+    saved = reg.get("ps.wire.bytes_saved")
+    if saved:
+        # Compact compression fingerprint: a replica pushing quantized or
+        # sparse gradients shows its cumulative wire savings in the fleet
+        # table (exact-wire replicas keep the column off, like recov).
+        cols.append(f"wiresave {_fmt_bytes(saved)}")
     counts = (status.get("recovery") or {}).get("counts") or {}
     if any(counts.values()):
         # Compact recovery fingerprint: evictions/rejoins/rollbacks/respawns

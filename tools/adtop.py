@@ -197,6 +197,13 @@ def render(status: dict, address: str = "") -> str:
                      f"{wire.get('msgs_sent', 0)}msg  "
                      f"rx {wire.get('bytes_received', 0):,}B/"
                      f"{wire.get('msgs_received', 0)}msg")
+    saved = reg.get("ps.wire.bytes_saved", 0)
+    if saved:
+        # The push compressor's accounting (in-process workers mirror into
+        # this registry; absent — exact wire — the line stays off screen).
+        lines.append(f"compress saved {int(saved):,}B  "
+                     f"quantized {int(reg.get('ps.wire.bytes_quantized', 0)):,}B"
+                     f"  {reg.get('wire.quantize_s', 0.0):.3f}s quantize")
     if kind == "ps":
         bound = status.get("staleness_bound")
         version = status.get("version")
