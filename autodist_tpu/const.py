@@ -80,6 +80,14 @@ KNOWN_FLAGS = {
                             "requests are rejected, not parked",
     "AUTODIST_SERVE_TIMEOUT_S": "server-side cap (seconds) on one serving "
                                 "request's completion wait",
+    "AUTODIST_SERVE_REPLICAS": "fleet-router replica count: InferenceServer "
+                               "replicas the router spawns/fronts",
+    "AUTODIST_KV_PAGE_LEN": "paged-KV page length in tokens (0 = the dense "
+                            "per-slot slab, the pre-paging behavior)",
+    "AUTODIST_PREFIX_CACHE": "paged-KV shared-prefix cache: requests with a "
+                             "common prompt prefix reuse prefilled pages",
+    "AUTODIST_ROUTER_ADDR": "fleet-router transport host:port for serving "
+                            "clients (empty = loopback, OS-picked port)",
     "AUTODIST_HEALTH": "training-health monitors: per-step on-device "
                        "numerics bundle (grad norm, update/param ratio, "
                        "NaN/Inf) + host-side loss-spike detection",
@@ -267,6 +275,15 @@ _ENV_DEFAULTS = {
     "AUTODIST_SERVE_MODE": "continuous",
     "AUTODIST_SERVE_QUEUE": 256,
     "AUTODIST_SERVE_TIMEOUT_S": 120.0,
+    # Fleet serving (autodist_tpu/serving/router.py + serving/paged.py):
+    # replica count the router fronts, paged-KV page length in tokens
+    # (0 keeps the dense per-slot slab), the shared-prefix page cache
+    # toggle, and the router's own transport address. ServeConfig.from_env()
+    # reads the KV knobs; Router reads the fleet knobs.
+    "AUTODIST_SERVE_REPLICAS": 2,
+    "AUTODIST_KV_PAGE_LEN": 0,
+    "AUTODIST_PREFIX_CACHE": True,
+    "AUTODIST_ROUTER_ADDR": "",
     # Training-health plane (autodist_tpu/telemetry/health.py): per-step
     # on-device numerics bundle + host-side loss-spike detection, and the
     # policy an anomaly triggers. Off by default — the step body stays
@@ -377,6 +394,10 @@ class ENV(enum.Enum):
     AUTODIST_SERVE_MODE = "AUTODIST_SERVE_MODE"
     AUTODIST_SERVE_QUEUE = "AUTODIST_SERVE_QUEUE"
     AUTODIST_SERVE_TIMEOUT_S = "AUTODIST_SERVE_TIMEOUT_S"
+    AUTODIST_SERVE_REPLICAS = "AUTODIST_SERVE_REPLICAS"
+    AUTODIST_KV_PAGE_LEN = "AUTODIST_KV_PAGE_LEN"
+    AUTODIST_PREFIX_CACHE = "AUTODIST_PREFIX_CACHE"
+    AUTODIST_ROUTER_ADDR = "AUTODIST_ROUTER_ADDR"
     AUTODIST_HEALTH = "AUTODIST_HEALTH"
     AUTODIST_HEALTH_ACTION = "AUTODIST_HEALTH_ACTION"
     AUTODIST_HEALTH_ZMAX = "AUTODIST_HEALTH_ZMAX"

@@ -29,6 +29,37 @@ from autodist_tpu.parallel import recovery as _recovery
 from autodist_tpu.utils import logging
 
 
+class RespawnPolicy:
+    """Budgeted, jittered-exponential-backoff respawn ledger — the chief's
+    worker-failure reaction (:meth:`Coordinator._respawn`), promoted to a
+    reusable policy object so the serving fleet router drives the SAME
+    discipline for dead-replica replacement and alert-driven autoscaling
+    (``serving/router.py``): at most ``AUTODIST_RECOVER_MAX`` granted
+    attempts per key, each booked via ``recovery.log_respawn`` with its
+    backoff delay."""
+
+    def __init__(self, base_s: float = 1.0, cap_s: float = 30.0):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.attempts: Dict[str, int] = {}
+
+    def budget(self) -> int:
+        return _recovery.recover_max()
+
+    def grant(self, key: str) -> Optional[float]:
+        """One respawn attempt for ``key``: the backoff delay (seconds) the
+        caller should wait before relaunching, booked in the recovery
+        plane; ``None`` when the budget is spent (the caller escalates —
+        halt for the chief, stay-down for a router replica)."""
+        n = self.attempts.get(key, 0)
+        if n >= self.budget():
+            return None
+        self.attempts[key] = n + 1
+        delay = _recovery.backoff_s(n, self.base_s, self.cap_s)
+        _recovery.log_respawn(str(key), n + 1, delay)
+        return delay
+
+
 class Coordinator:
     # Respawn backoff: base doubles per attempt (jittered), capped. Class
     # attributes so tests (and future elastic policies) can tighten them.
@@ -127,26 +158,28 @@ class Coordinator:
         os._exit(1)
 
     def _respawn(self, address: str, code: int) -> bool:
-        """One respawn attempt for ``address``; False when the budget is
-        spent or the address is unknown (caller escalates to halt)."""
+        """One respawn attempt for ``address`` via :class:`RespawnPolicy`;
+        False when the budget is spent or the address is unknown (caller
+        escalates to halt). The attempt ledger lives in the launch spec
+        (``spec["respawns"]``) so it survives across failures."""
         spec = self._launch_specs.get(address)
-        budget = _recovery.recover_max()
-        if spec is None or spec["respawns"] >= budget:
-            if spec is not None:
-                logging.error(
-                    "Worker %s exited with code %s and its respawn budget "
-                    "(%d, AUTODIST_RECOVER_MAX) is spent; escalating to "
-                    "halt", address, code, budget)
+        if spec is None:
             return False
-        spec["respawns"] += 1
-        delay = _recovery.backoff_s(spec["respawns"] - 1,
-                                    self.RESPAWN_BACKOFF_S,
-                                    self.RESPAWN_BACKOFF_CAP_S)
+        policy = RespawnPolicy(self.RESPAWN_BACKOFF_S,
+                               self.RESPAWN_BACKOFF_CAP_S)
+        policy.attempts[address] = spec["respawns"]
+        delay = policy.grant(address)      # books recovery.log_respawn
+        if delay is None:
+            logging.error(
+                "Worker %s exited with code %s and its respawn budget "
+                "(%d, AUTODIST_RECOVER_MAX) is spent; escalating to "
+                "halt", address, code, policy.budget())
+            return False
+        spec["respawns"] = policy.attempts[address]
         logging.warning(
             "Worker %s exited with code %s; respawning in %.1fs "
             "(attempt %d/%d)", address, code, delay, spec["respawns"],
-            budget)
-        _recovery.log_respawn(address, spec["respawns"], delay)
+            policy.budget())
         time.sleep(delay)   # bounded: RESPAWN_BACKOFF_CAP_S
         proc = self._cluster.remote_exec(spec["cmd"], address,
                                          env=spec["env"])

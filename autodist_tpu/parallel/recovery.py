@@ -95,9 +95,16 @@ class _RecoveryLog:
             self._counts[category] += 1
         return record
 
-    def note_generation(self, worker_id: int, generation: int):
+    def note_generation(self, worker_id, generation: int):
+        # PS gate slots use numeric ids; the serving fleet router books its
+        # replicas by "host:port". Normalize int-able ids (so PS records
+        # keep their historical int keys) and keep the rest as strings.
+        try:
+            worker_id = int(worker_id)
+        except (TypeError, ValueError):
+            worker_id = str(worker_id)
         with self._lock:
-            self._generations[int(worker_id)] = int(generation)
+            self._generations[worker_id] = int(generation)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -106,7 +113,8 @@ class _RecoveryLog:
                     "rollbacks": list(self._rollbacks),
                     "respawns": list(self._respawns),
                     "counts": dict(self._counts),
-                    "generations": dict(sorted(self._generations.items()))}
+                    "generations": dict(sorted(self._generations.items(),
+                                               key=lambda kv: str(kv[0])))}
 
 
 _LOG = _RecoveryLog()

@@ -54,6 +54,15 @@ class ServeError(RuntimeError):
     server-side failure) — shipped to remote clients as an error reply."""
 
 
+class ServeBusy(ServeError):
+    """Typed overload rejection: the admission queue (or the paged-KV pool
+    behind it) is full RIGHT NOW, but the request itself is valid — retry
+    later, or on another replica. The fleet router keys its shed-vs-fail
+    decision on this type: a ``ServeBusy`` from one replica cascades to the
+    next; any other ``ServeError`` is deterministic and is surfaced to the
+    client unchanged."""
+
+
 def default_buckets(max_len: int, floor: int = 8) -> Tuple[int, ...]:
     """Power-of-two prompt pad lengths up to ``max_len`` (inclusive as the
     last bucket even when max_len is not a power of two) — one jitted prefill
@@ -103,6 +112,12 @@ class ServeConfig:
     top_k: int = 0
     top_p: float = 0.0
     eos_id: int = -1            # generation stops at this token id; -1 disables
+    # Paged-KV knobs (serving/paged.py): page length in tokens (0 = the
+    # dense per-slot slab), pool size in pages (0 = derived at HBM parity
+    # with the dense slab), and the shared-prefix page cache toggle.
+    page_len: int = 0           # AUTODIST_KV_PAGE_LEN; 0 = dense slab
+    kv_pages: int = 0           # pool pages incl. scratch; 0 = derived
+    prefix_cache: bool = True   # AUTODIST_PREFIX_CACHE
 
     def __post_init__(self):
         if self.mode not in ("continuous", "static"):
@@ -112,6 +127,8 @@ class ServeConfig:
             raise ValueError("max_batch must be >= 1")
         if self.buckets and list(self.buckets) != sorted(self.buckets):
             raise ValueError("buckets must be ascending")
+        if self.page_len < 0 or self.kv_pages < 0:
+            raise ValueError("page_len/kv_pages must be >= 0")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -119,7 +136,9 @@ class ServeConfig:
         base = dict(max_batch=const.ENV.AUTODIST_SERVE_MAX_BATCH.val,
                     mode=const.ENV.AUTODIST_SERVE_MODE.val,
                     max_queue=const.ENV.AUTODIST_SERVE_QUEUE.val,
-                    request_timeout_s=const.ENV.AUTODIST_SERVE_TIMEOUT_S.val)
+                    request_timeout_s=const.ENV.AUTODIST_SERVE_TIMEOUT_S.val,
+                    page_len=const.ENV.AUTODIST_KV_PAGE_LEN.val,
+                    prefix_cache=const.ENV.AUTODIST_PREFIX_CACHE.val)
         base.update(overrides)
         return ServeConfig(**base)
 
@@ -272,7 +291,7 @@ class _BatcherBase:
             raise ServeError("server is shutting down") from None
         if not admitted:
             self._metrics.rejected.inc()
-            raise ServeError(
+            raise ServeBusy(
                 f"serving queue is full ({self.config.max_queue} "
                 f"waiting); retry later")
         self._metrics.submitted.inc()
@@ -361,6 +380,13 @@ class Batcher(_BatcherBase):
     def __init__(self, engine, config: ServeConfig, start: bool = True):
         super().__init__(engine, config, "serve-batcher")
         self._slots: List[Optional[ServeRequest]] = [None] * engine.capacity
+        # Admission holdback: a request popped from the queue that the
+        # engine cannot admit YET (paged engines gate on free pages, not
+        # free slots) parks here and is retried FIRST next round —
+        # BoundedQueue has no push-front, and skipping it would reorder
+        # FIFO admission. Only the scheduler thread touches it (close()
+        # collects it after the join, under _lock, via _inflight_locked).
+        self._held: Optional[ServeRequest] = None
         if start:
             self._start()
 
@@ -402,6 +428,9 @@ class Batcher(_BatcherBase):
     def _inflight_locked(self) -> List[ServeRequest]:
         inflight = [r for r in self._slots if r is not None]
         self._slots = [None] * len(self._slots)
+        if self._held is not None:
+            inflight.append(self._held)
+            self._held = None
         return inflight
 
     @property
@@ -484,18 +513,37 @@ class Batcher(_BatcherBase):
         with self._lock:
             free = [s for s, r in enumerate(self._slots) if r is None]
             n_slots = len(self._slots)
-        if not len(self._waiting) or not free:
+        if (self._held is None and not len(self._waiting)) or not free:
             return
         if self.config.mode == "static" and len(free) != n_slots:
             return
+        # Paged engines expose can_admit(prompt_len, max_new) — admission
+        # gates on RESERVABLE PAGES, not free slots. A request that cannot
+        # be admitted yet holds back (FIFO preserved); one that can NEVER
+        # fit (needs more pages than the pool owns) raises and is rejected
+        # here instead of blocking the head of the line forever.
+        can_admit = getattr(self._engine, "can_admit", None)
         batch: List[Tuple[int, ServeRequest]] = []
         while free:
-            req = self._waiting.pop_nowait()
-            if req is EMPTY:
-                break
+            if self._held is not None:
+                req, self._held = self._held, None
+            else:
+                req = self._waiting.pop_nowait()
+                if req is EMPTY:
+                    break
             if req.dead(now):
                 dropped.append(req)
                 continue
+            if can_admit is not None:
+                try:
+                    ok = can_admit(int(req.prompt.size), req.max_new_tokens)
+                except ServeError as e:
+                    req.finish(error=str(e))
+                    self._metrics.rejected.inc()
+                    continue
+                if not ok:
+                    self._held = req
+                    break
             batch.append((free.pop(0), req))
         self._metrics.depth.set(len(self._waiting))
         with self._lock:

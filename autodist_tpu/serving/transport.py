@@ -25,9 +25,11 @@ wire. Malformed payloads (wrong types, oversize prompts, full queue) get an
 its own rejection, nothing more.
 """
 
+import socket
 import socketserver
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional, Tuple
 
 import numpy as np
@@ -37,7 +39,7 @@ from autodist_tpu.parallel import wire
 from autodist_tpu.parallel.ps_transport import (_PSClient, _RecvBuffer,
                                                 _recv_msg, _send_payload,
                                                 PSClientError)
-from autodist_tpu.serving.batcher import ServeError
+from autodist_tpu.serving.batcher import ServeBusy, ServeError
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import WireCounters
 
@@ -45,6 +47,66 @@ from autodist_tpu.utils.metrics import WireCounters
 # batcher must not park a handler thread forever (GL005's rule at the trust
 # boundary); a single generation this long is operationally dead anyway.
 MAX_WAIT_S = 600.0
+
+# Completed-reply dedup entries kept per server (see the ``generate`` arm):
+# the router's replay window is one in-flight set, so a small bound holds.
+DEDUP_KEEP = 512
+
+
+def _wire_server(host: str, port: int, owner) -> socketserver.TCPServer:
+    """The shared thread-per-connection wire loop behind both serving
+    endpoints (:class:`InferenceServer` and the fleet ``RouterServer``):
+    recv typed message -> ``owner._dispatch(msg, span)`` -> send typed
+    reply. ``owner`` provides ``wire`` (counters), ``_dispatch`` and
+    ``_conns`` (live handler sockets, so ``kill()`` can sever them)."""
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            pool = _RecvBuffer()
+            owner._conns.add(self.request)
+            try:
+                while True:
+                    msg, _ = _recv_msg(self.request, pool=pool,
+                                       counters=owner.wire)
+                    is_protocol = isinstance(msg, tuple) and bool(msg)
+                    op = msg[0] if is_protocol else "<malformed>"
+                    with telemetry.span("serve.request", op=str(op)) as sp:
+                        # The dispatch stamps the request id it assigns
+                        # onto this span (sp.set(rid=...)) so one id ties
+                        # the transport span, the batcher's prefill/
+                        # decode spans, and the reply timing together.
+                        reply = owner._dispatch(msg, sp)
+                    try:
+                        payload = wire.encode_parts(reply)
+                    except wire.WireError as e:
+                        # OUR reply is unencodable (e.g. a model output
+                        # pytree with an unregistered node) — a server
+                        # limitation, not a hostile peer: report it.
+                        logging.warning(
+                            "serve transport: reply to %r is not "
+                            "wire-encodable (%s)", op, e)
+                        payload = wire.encode_parts((
+                            "error", "WireError",
+                            f"server reply to {op!r} is not "
+                            f"wire-encodable: {e}"))
+                    n = _send_payload(self.request, payload)
+                    owner.wire.add_sent(n)
+                    # Drop aliases into the recv buffer before the next
+                    # recv so the pool can recycle it.
+                    msg = reply = payload = None
+            except wire.WireError as e:
+                logging.warning("serve transport: dropping connection "
+                                "with malformed payload (%s)", e)
+            except (ConnectionError, OSError):
+                pass  # client went away; its requests complete unobserved
+            finally:
+                owner._conns.discard(self.request)
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    return Server((host, port), Handler)
 
 
 def _env_address() -> Tuple[str, int]:
@@ -78,53 +140,15 @@ class InferenceServer:
         self._batcher = batcher
         self._t_started = time.monotonic()
         self.wire = WireCounters()
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                pool = _RecvBuffer()
-                try:
-                    while True:
-                        msg, _ = _recv_msg(self.request, pool=pool,
-                                           counters=outer.wire)
-                        is_protocol = isinstance(msg, tuple) and bool(msg)
-                        op = msg[0] if is_protocol else "<malformed>"
-                        with telemetry.span("serve.request",
-                                            op=str(op)) as sp:
-                            # The dispatch stamps the request id it assigns
-                            # onto this span (sp.set(rid=...)) so one id ties
-                            # the transport span, the batcher's prefill/
-                            # decode spans, and the reply timing together.
-                            reply = outer._dispatch(msg, sp)
-                        try:
-                            payload = wire.encode_parts(reply)
-                        except wire.WireError as e:
-                            # OUR reply is unencodable (e.g. a model output
-                            # pytree with an unregistered node) — a server
-                            # limitation, not a hostile peer: report it.
-                            logging.warning(
-                                "serve transport: reply to %r is not "
-                                "wire-encodable (%s)", op, e)
-                            payload = wire.encode_parts((
-                                "error", "WireError",
-                                f"server reply to {op!r} is not "
-                                f"wire-encodable: {e}"))
-                        n = _send_payload(self.request, payload)
-                        outer.wire.add_sent(n)
-                        # Drop aliases into the recv buffer before the next
-                        # recv so the pool can recycle it.
-                        msg = reply = payload = None
-                except wire.WireError as e:
-                    logging.warning("serve transport: dropping connection "
-                                    "with malformed payload (%s)", e)
-                except (ConnectionError, OSError):
-                    pass  # client went away; its requests complete unobserved
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
+        # Request-id dedup for the fleet router's replay path (GL011: the
+        # ``generate`` op is NOT wire-retried — replay happens one level up,
+        # made idempotent here): a completed rid's reply is cached, so a
+        # router that re-sends an in-flight request after a replica death
+        # can never double-generate on a replica that already finished it.
+        self._dedup: "OrderedDict[str, tuple]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self._conns: set = set()
+        self._server = _wire_server(host, port, self)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -208,11 +232,26 @@ class InferenceServer:
                 if self._batcher.kind != "lm":
                     raise ServeError("this server hosts a stateless apply "
                                      "batcher; use the 'infer' op")
-                _, prompt, max_new, seed, timeout = msg
+                # Optional trailing element: the router's replay-dedup
+                # token. Plain clients send the 5-tuple; arity stays
+                # backward compatible either way.
+                _, prompt, max_new, seed, timeout, *rest = msg
+                rid_token = str(rest[0]) if rest else None
+                if rid_token is not None:
+                    with self._dedup_lock:
+                        cached = self._dedup.get(rid_token)
+                    if cached is not None:
+                        return cached
                 req = self._batcher.submit(prompt, max_new, seed=int(seed))
                 if sp is not None:
                     sp.set(rid=req.rid)
-                return self._wait(req, timeout)
+                reply = self._wait(req, timeout)
+                if rid_token is not None and reply[0] == "ok":
+                    with self._dedup_lock:
+                        self._dedup[rid_token] = reply
+                        while len(self._dedup) > DEDUP_KEEP:
+                            self._dedup.popitem(last=False)
+                return reply
             if op == "infer":
                 if self._batcher.kind != "apply":
                     raise ServeError("this server hosts an LM batcher; use "
@@ -244,6 +283,28 @@ class InferenceServer:
                          self.wire.format_line(),
                          time.monotonic() - self._t_started)
 
+    def kill(self):
+        """Simulate abrupt process death (fault injection — the router's
+        kill-a-replica path and ``testing/faults`` ``worker_crash``): stop
+        accepting, SEVER every live connection mid-reply, stop the batcher.
+        Clients observe connection resets — exactly what a killed replica
+        process produces — and the router replays their in-flight requests
+        on a surviving replica (rid dedup makes the replay idempotent)."""
+        self._server.shutdown()
+        self._server.server_close()
+        for s in list(self._conns):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        # Drain-and-fail is harmless here: the severed sockets mean nobody
+        # reads these replies; it just stops the scheduler thread.
+        self._batcher.close()
+
 
 class ServeClient:
     """A client handle onto an :class:`InferenceServer`.
@@ -266,12 +327,19 @@ class ServeClient:
                  timeout: Optional[float] = None):
         """``prompt`` (1-D int array-like) -> ``(tokens int32[T], timing)``
         where timing is the server's ``{queue,prefill,decode,total}_s``
-        breakdown. Raises :class:`ServeError` on rejection."""
+        breakdown. Raises :class:`ServeBusy` on an overload rejection
+        (retryable — the queue or page pool is full right now) and
+        :class:`ServeError` on any other rejection."""
         prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
         try:
             tokens, timing = self._client.call(
                 "generate", prompt, int(max_new_tokens), int(seed), timeout)
         except PSClientError as e:
+            # The wire ships ("error", type-name, detail); re-type the
+            # busy rejection so callers (the router's shed cascade) can
+            # branch on it without string matching.
+            if str(e).startswith("ServeBusy:"):
+                raise ServeBusy(str(e)) from None
             raise ServeError(str(e)) from None
         return np.asarray(tokens), timing
 

@@ -1095,6 +1095,226 @@ def serve_bench(requests: int = 32, clients: int = 8, max_batch: int = 4):
     return result
 
 
+def serve_fleet_bench(requests: int = 24, fleet_requests: int = 16,
+                      clients: int = 4):
+    """Fleet-serving bench (PR 17): three legs, gated against the
+    ``serve_fleet`` row in PERF_BASELINE.json.
+
+    1. PAGED vs DENSE concurrency at the SAME KV HBM budget. The dense
+       engine owns ``4 x max_len`` slot-rows; the paged engine owns the same
+       token count as pages (plus the scratch page) and admits on RESERVABLE
+       PAGES, so short requests pack ``>= min_concurrency_ratio`` times more
+       concurrent work into the identical memory. Both engines serve the
+       identical request set through a real Batcher and the gate REQUIRES
+       bit-identical token streams — the capacity win is worthless if the
+       math changed (this is a RuntimeError, not a warning).
+    2. Router 2-replica vs 1-replica offered-load rps through a real
+       RouterServer + unchanged ServeClients. On a shared-core CPU box the
+       replicas contend for the same host, so the recorded floor is a wide
+       "adding a replica must not collapse throughput" guard, not a 2x pin
+       (on real fleets each replica owns its chips).
+    3. Kill-a-replica: ``clients`` closed-loop clients against a 2-replica
+       fleet; one replica is killed with requests IN FLIGHT. The contract
+       (RuntimeError on violation, same discipline as the selfheal bench):
+       every request completes, ZERO client-visible failures, and the
+       recovery plane books >= 1 respawn — the router replayed the severed
+       requests (same rid, replica-side dedup) onto the survivor and healed
+       the fleet."""
+    import sys
+    import threading
+
+    import jax.numpy as jnp
+
+    from autodist_tpu import serving
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.parallel import recovery as _recovery
+    from autodist_tpu.serving.router import Router, RouterServer
+
+    import jax
+    platform = jax.devices()[0].platform
+
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=2, n_layers=2, d_ff=256,
+        max_len=128, dtype=jnp.float32)
+    model, params = transformer_lm.init_params(cfg)
+
+    # ---- leg 1: paged vs dense concurrency at equal KV HBM -------------
+    # Dense: 4 slots x 128 tokens = 512 KV rows. Paged: 32 usable 16-token
+    # pages = the same 512 rows (+1 scratch page), but a 2-page request
+    # only OCCUPIES 2 pages, so 16 of them run concurrently.
+    dense_cfg = serving.ServeConfig(max_batch=4, temperature=0.0)
+    paged_cfg = serving.ServeConfig(max_batch=16, temperature=0.0,
+                                    page_len=16, kv_pages=33)
+    rng = np.random.RandomState(0)
+    workload = [(rng.randint(1, cfg.vocab_size,
+                             size=int(rng.randint(6, 15))).astype(np.int32),
+                 12, i) for i in range(requests)]
+
+    def run_engine(engine, scfg):
+        batcher = serving.Batcher(engine, scfg, start=False)
+        reqs = [batcher.submit(p, n, seed=s) for p, n, s in workload]
+        peak = 0
+        for _ in range(4000):
+            if all(r.done.is_set() for r in reqs):
+                break
+            batcher.run_once()
+            peak = max(peak, len(batcher.in_flight_snapshot()))
+        bad = [r.error for r in reqs if r.error or not r.done.is_set()]
+        if bad:
+            raise RuntimeError(f"serve-fleet bench: engine leg failed: "
+                               f"{bad[:3]}")
+        return [tuple(r.tokens) for r in reqs], peak
+
+    dense_tokens, dense_peak = run_engine(
+        serving.LMEngine(model, params, dense_cfg), dense_cfg)
+    paged_tokens, paged_peak = run_engine(
+        serving.PagedLMEngine(model, params, paged_cfg), paged_cfg)
+    if paged_tokens != dense_tokens:
+        raise RuntimeError(
+            "serve-fleet bench: paged tokens diverged from dense — the "
+            "paged KV cache broke bit-identity (see serving/paged.py)")
+    concurrency_ratio = round(paged_peak / max(1, dense_peak), 3)
+
+    # ---- legs 2+3: router fleet rps and kill-a-replica -----------------
+    def replica_factory():
+        scfg = serving.ServeConfig(max_batch=4, temperature=0.0)
+        batcher = serving.Batcher(
+            serving.LMEngine(model, params, scfg), scfg)
+        return serving.InferenceServer(batcher)
+
+    def offered_load(router_server, n, max_new):
+        ok, errors = [], []
+        lock = threading.Lock()
+
+        def client_thread(wid):
+            c = serving.ServeClient(router_server.address)
+            try:
+                for i in range(wid, n, clients):
+                    try:
+                        prompt = np.arange(1, 9, dtype=np.int32) + i % 40
+                        tokens, _ = c.generate(prompt, max_new, seed=i)
+                        with lock:
+                            ok.append(tokens)
+                    except serving.ServeError as e:
+                        with lock:
+                            errors.append(str(e))
+            finally:
+                c.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client_thread, args=(w,))
+                   for w in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return ok, errors, time.perf_counter() - t0
+
+    fleet_rps = {}
+    for n_replicas in (1, 2):
+        router = Router(replica_factory, n_replicas=n_replicas, start=False)
+        server = RouterServer(router)
+        try:
+            # Warm EVERY replica's programs off the clock, addressed
+            # directly — the router's least-loaded tie-break would send
+            # every idle sequential warm to replica 0 and leave the
+            # others to compile on the clock.
+            for rep in router.replicas():
+                warm = serving.ServeClient(rep.address)
+                try:
+                    warm.generate(np.arange(1, 9, dtype=np.int32), 2)
+                finally:
+                    warm.close()
+            ok, errors, wall = offered_load(server, fleet_requests, 8)
+            if errors or len(ok) != fleet_requests:
+                raise RuntimeError(
+                    f"serve-fleet bench ({n_replicas} replica(s)): "
+                    f"{len(ok)}/{fleet_requests} ok, errors: {errors[:3]}")
+            fleet_rps[n_replicas] = round(fleet_requests / wall, 2)
+        finally:
+            server.close()
+    fleet_ratio = round(fleet_rps[2] / max(1e-9, fleet_rps[1]), 3)
+
+    # Kill leg: requests in flight, one replica dies, nobody notices.
+    _recovery.reset()
+    old_backoff = Router.RESPAWN_BACKOFF_S
+    Router.RESPAWN_BACKOFF_S = 0.05
+    try:
+        router = Router(replica_factory, n_replicas=2, start=False)
+        server = RouterServer(router)
+        try:
+            for rep in router.replicas():
+                warm = serving.ServeClient(rep.address)
+                try:
+                    warm.generate(np.arange(1, 9, dtype=np.int32), 2)
+                finally:
+                    warm.close()
+            victim = router.replicas()[0]
+
+            def killer():
+                deadline = time.monotonic() + 10.0
+                while victim.in_flight == 0 and time.monotonic() < deadline:
+                    time.sleep(0.001)
+                victim.server.kill()
+
+            kt = threading.Thread(target=killer)
+            kt.start()
+            ok, errors, _ = offered_load(server, fleet_requests, 24)
+            kt.join()
+            counts = _recovery.recovery_snapshot()["counts"]
+            if errors or len(ok) != fleet_requests:
+                raise RuntimeError(
+                    f"serve-fleet bench (kill leg): {len(ok)}/"
+                    f"{fleet_requests} completed, errors: {errors[:3]} — "
+                    f"a replica death leaked to clients")
+            if counts.get("respawns", 0) < 1:
+                raise RuntimeError(
+                    "serve-fleet bench (kill leg): no respawn booked — the "
+                    "kill never landed mid-flight; the leg proved nothing")
+        finally:
+            server.close()
+    finally:
+        Router.RESPAWN_BACKOFF_S = old_backoff
+
+    result = {
+        "metric": f"serve_fleet ({platform}, d{cfg.d_model}x{cfg.n_layers}, "
+                  f"dense 4x{cfg.max_len} vs paged 32x16 pages, "
+                  f"{clients} clients)",
+        "rows": {"dense_peak": dense_peak, "paged_peak": paged_peak,
+                 "fleet1_rps": fleet_rps[1], "fleet2_rps": fleet_rps[2]},
+        "concurrency_ratio": concurrency_ratio,
+        "fleet_rps_ratio": fleet_ratio,
+        "kill_leg": {"completed": len(ok), "respawns": counts["respawns"],
+                     "evicted": counts["evicted"]},
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("serve_fleet")
+        if recorded:
+            floor = recorded.get("min_concurrency_ratio", 1.5)
+            if concurrency_ratio < floor:
+                print(f"WARNING: paged concurrency is "
+                      f"{concurrency_ratio:.2f}x dense at equal KV HBM — "
+                      f"below the {floor:.2f}x gate; page packing stopped "
+                      f"paying for itself (see PERF_BASELINE.json "
+                      f"serve_fleet)", file=sys.stderr)
+            rps_floor = recorded.get("min_fleet_rps_ratio", 0.5)
+            if fleet_ratio < rps_floor:
+                print(f"WARNING: 2-replica rps is {fleet_ratio:.2f}x "
+                      f"1-replica — below the {rps_floor:.2f}x guard; "
+                      f"routing overhead is eating the fleet (see "
+                      f"PERF_BASELINE.json serve_fleet)", file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "concurrency_ratio": concurrency_ratio,
+                        "fleet_rps_ratio": fleet_ratio,
+                        "fleet2_rps": fleet_rps[2],
+                        "kill_respawns": counts["respawns"]})
+    return result
+
+
 def unroll_sweep(factors):
     """Measure the fused multi-step path (``runner.run_many``) at each unroll
     factor and print ONE JSON line with the steps/s curve.
@@ -1842,6 +2062,15 @@ def main(argv=None):
              "serving row in PERF_BASELINE.json (continuous must beat static "
              "on requests/s at equal-or-better p99)")
     parser.add_argument(
+        "--serve-fleet", action="store_true",
+        help="measure fleet serving: paged vs dense concurrent requests at "
+             "the same KV HBM budget with bit-identical outputs (gated "
+             "against min_concurrency_ratio in the PERF_BASELINE.json "
+             "serve_fleet row), router 2-replica vs 1-replica rps, and the "
+             "kill-a-replica leg (one replica killed with requests in "
+             "flight must cost ZERO client-visible failures and book >= 1 "
+             "respawn)")
+    parser.add_argument(
         "--data-plane", action="store_true",
         help="measure the input-data plane: train() under an injected slow "
              "host loader (fixed per-batch sleep), synchronous feed vs the "
@@ -1902,6 +2131,9 @@ def main(argv=None):
         return
     if args.serve:
         serve_bench()
+        return
+    if args.serve_fleet:
+        serve_fleet_bench()
         return
     if args.data_plane:
         data_plane_bench()

@@ -164,6 +164,11 @@ python bench.py --autotune
 # Serving plane gate: continuous batching must beat static wave batching
 # on loopback requests/s at equal-or-better p99 (serving row).
 python bench.py --serve
+# Fleet-serving gate: paged KV must pack >= min_concurrency_ratio x the
+# dense slab's concurrent requests at the SAME HBM with bit-identical
+# outputs, and the kill-a-replica leg must complete every request with
+# zero client-visible failures and a booked respawn (serve_fleet row).
+python bench.py --serve-fleet
 python bench.py
 
 echo "=== CI OK ==="

@@ -1,0 +1,558 @@
+"""Fleet serving (PR 17): paged KV cache + multi-replica router.
+
+NAMED to sort inside the tier-1 alphabetical window (with the other serve
+tests). No subprocesses: replicas are in-process ``InferenceServer``s over
+loopback, killed via ``InferenceServer.kill()`` (severed sockets — exactly
+what a dead replica process looks like to the router).
+
+Coverage per the PR 17 contract:
+- page allocator / page-bucket units (jax-free);
+- paged engine output is BIT-IDENTICAL to the dense ``LMEngine`` through
+  the real batcher, greedy and sampled, including shared-prefix admits;
+- a prefix-cache hit produces identical tokens while booking
+  ``serve.kv.prefix_hits`` and skipping the shared pages' prefill work;
+- page-pool exhaustion HOLDS BACK admission (FIFO preserved) and sheds
+  with a typed ``ServeBusy`` at the queue bound — never mid-decode
+  corruption; an impossible request is rejected up front;
+- page REUSE staleness: freed pages are poisoned with garbage and the next
+  owner's tokens don't change (the dense slot-reuse invariant, re-pinned
+  for pages — garbage must stay finite/bounded so the additive -1e9 mask
+  zeroes it exactly; that bound is the documented invariant);
+- router least-loaded spread, typed shed cascade, replay-with-same-rid
+  around a killed replica (ZERO client-visible failures, >= 1 booked
+  respawn), replica-side rid dedup (GL011: replay is idempotent), and
+  alert-driven drain + scale-out via ``poll_once``;
+- the new env flags are registered (GL007's runtime face).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from autodist_tpu import telemetry  # noqa: E402
+from autodist_tpu.coordinator import RespawnPolicy  # noqa: E402
+from autodist_tpu.models import transformer_lm  # noqa: E402
+from autodist_tpu.models.transformer_lm import TransformerLMConfig  # noqa: E402
+from autodist_tpu.parallel import recovery as _recovery  # noqa: E402
+from autodist_tpu.serving import (Batcher, LMEngine, PageAllocator,  # noqa: E402
+                                  PagedLMEngine, Router, RouterServer,
+                                  ServeBusy, ServeConfig, ServeError,
+                                  InferenceServer, ServeClient,
+                                  default_buckets, page_buckets)
+from autodist_tpu.testing import faults  # noqa: E402
+
+
+# ------------------------------------------------------------------ fixtures
+
+def _small_cfg(**kw):
+    kw.setdefault("vocab_size", 97)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("dtype", jnp.float32)   # exact-comparison friendly
+    return TransformerLMConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = _small_cfg()
+    model, params = transformer_lm.init_params(cfg)
+    return model, params
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 97, size=n).astype(np.int32)
+
+
+def _drive(batcher, reqs, rounds=200):
+    for _ in range(rounds):
+        if all(r.done.is_set() for r in reqs):
+            break
+        batcher.run_once()
+    assert all(r.done.is_set() for r in reqs), "batcher did not converge"
+
+
+def _tokens_via_batcher(engine, config, requests):
+    """Drive ``requests`` = [(prompt, max_new, seed), ...] through a real
+    (unstarted) Batcher; returns each request's token tuple in input order."""
+    b = Batcher(engine, config, start=False)
+    reqs = [b.submit(p, n, seed=s) for p, n, s in requests]
+    _drive(b, reqs)
+    for r in reqs:
+        assert r.error is None, r.error
+    return [tuple(r.tokens) for r in reqs]
+
+
+def _counter(name):
+    v = telemetry.snapshot().get(name)
+    return int(v) if isinstance(v, (int, float)) else 0
+
+
+# --------------------------------------------------- page allocator units
+
+def test_page_buckets_pow2_with_exact_max():
+    assert page_buckets(8) == (1, 2, 4, 8)
+    assert page_buckets(5) == (1, 2, 4, 5)    # non-pow2 max included
+    assert page_buckets(1) == (1,)
+
+
+def test_page_allocator_reserve_alloc_refcount():
+    al = PageAllocator(5)          # 4 usable, page 0 is scratch
+    assert al.usable == 4 and al.free_count() == 4
+    al.reserve(3)
+    assert al.available() == 1
+    with pytest.raises(ServeError):
+        al.reserve(2)              # over-reserve is a typed refusal
+    pages = [al.alloc() for _ in range(3)]
+    assert 0 not in pages and len(set(pages)) == 3
+    assert al.free_count() == 1 and al.available() == 1
+    # refcount: a shared page survives one release, dies at zero.
+    al.retain(pages[0])
+    al.release(pages[0])
+    assert al.free_count() == 1
+    al.release(pages[0])
+    assert al.free_count() == 2
+    for p in pages[1:]:
+        al.release(p)
+    assert al.free_count() == 4
+
+
+def test_paged_engine_rejects_impossible_and_reserves():
+    al = PageAllocator(3)
+    al.reserve(2)
+    with pytest.raises(AssertionError):
+        # alloc beyond the reservation count is a programming error
+        al.alloc(), al.alloc(), al.alloc()
+
+
+# --------------------------------------------- paged vs dense bit-identity
+
+# Mixed lengths, some sharing an 8-token (one-page at page_len=8) prefix —
+# the shared-prefix admits exercise the split-prefill path against the
+# dense engine's one-shot prefill.
+_SHARED = _prompt(8, seed=7)
+_REQUESTS = [
+    (_prompt(5, seed=1), 4, 0),
+    (np.concatenate([_SHARED, _prompt(6, seed=2)]), 5, 1),
+    (_prompt(12, seed=3), 3, 2),
+    (np.concatenate([_SHARED, _prompt(3, seed=4)]), 6, 3),
+    (_prompt(1, seed=5), 4, 4),
+    (np.concatenate([_SHARED, _prompt(9, seed=6)]), 2, 5),
+]
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_paged_matches_dense_bit_identical(lm, temperature):
+    """The acceptance property: the paged engine's token streams equal the
+    dense engine's bit for bit, through the real batcher, greedy and
+    sampled, with prefix sharing in play."""
+    model, params = lm
+    dense_cfg = ServeConfig(max_batch=2, temperature=temperature)
+    paged_cfg = ServeConfig(max_batch=2, temperature=temperature, page_len=8)
+    dense = _tokens_via_batcher(LMEngine(model, params, dense_cfg),
+                                dense_cfg, _REQUESTS)
+    engine = PagedLMEngine(model, params, paged_cfg)
+    paged = _tokens_via_batcher(engine, paged_cfg, _REQUESTS)
+    assert paged == dense
+    # Concurrency headroom: page capacity exceeds the dense slot count at
+    # the same HBM budget (the whole point of paging).
+    assert engine.capacity > dense_cfg.max_batch
+    # Jit-cache boundedness: programs are keyed by (page-bucket, prompt
+    # bucket), never by request — the compile count is bucket-bounded.
+    n_prefill, n_total = engine.compiled_programs()
+    max_prefill = len(engine.buckets) * len(page_buckets(engine.max_pages))
+    assert n_prefill <= max_prefill
+    assert n_total - n_prefill <= len(page_buckets(engine.max_pages))
+
+
+def test_prefix_cache_hit_identical_tokens(lm):
+    """A content-matched page-aligned prefix is REUSED (prefix_hits books)
+    and the hit's tokens equal a no-cache engine's — shared pages are read
+    immutably, divergence lands in the request's own pages."""
+    model, params = lm
+    cfg = ServeConfig(max_batch=2, page_len=8)
+    requests = [(np.concatenate([_SHARED, _prompt(4, seed=11)]), 4, 0),
+                (np.concatenate([_SHARED, _prompt(7, seed=12)]), 4, 1)]
+    nocache_cfg = ServeConfig(max_batch=2, page_len=8, prefix_cache=False)
+    want = _tokens_via_batcher(PagedLMEngine(model, params, nocache_cfg),
+                               nocache_cfg, requests)
+    hits0 = _counter("serve.kv.prefix_hits")
+    engine = PagedLMEngine(model, params, cfg)
+    got = _tokens_via_batcher(engine, cfg, requests)
+    assert got == want
+    assert _counter("serve.kv.prefix_hits") > hits0
+    assert engine.pool_snapshot()["prefix_entries"] >= 1
+
+
+def test_page_reuse_staleness_poisoned_pages_are_invisible(lm):
+    """Satellite 6: freed pages return to the pool with stale K/V intact.
+    Poison EVERY free page with bounded garbage, then serve a request —
+    its tokens must equal a fresh engine's. (The invariant's boundary,
+    documented in serving/paged.py: the -1e9 additive mask zeroes any
+    FINITE bounded score exactly in f32 softmax; garbage of the same order
+    as the mask would not be recoverable, which is why pages are only ever
+    written by their owner.)"""
+    model, params = lm
+    cfg = ServeConfig(max_batch=2, page_len=8, prefix_cache=False)
+    probe = [(_prompt(10, seed=21), 5, 3)]
+    want = _tokens_via_batcher(PagedLMEngine(model, params, cfg), cfg, probe)
+
+    engine = PagedLMEngine(model, params, cfg)
+    # Occupy-and-free a first wave so real decode traffic has touched pages.
+    warm = [(_prompt(14, seed=22), 6, 1), (_prompt(3, seed=23), 8, 2)]
+    _tokens_via_batcher(engine, cfg, warm)
+    assert engine.num_active == 0
+    free_pages = np.asarray(engine._alloc._free, np.int32)
+    assert free_pages.size > 0
+    engine._pool = jax.tree_util.tree_map(
+        lambda leaf: leaf if leaf.ndim == 0
+        else leaf.at[free_pages].set(jnp.asarray(53.0, leaf.dtype)),
+        engine._pool)
+    got = _tokens_via_batcher(engine, cfg, probe)
+    assert got == want
+
+
+def test_page_exhaustion_holds_back_then_sheds(lm):
+    """A pool too small for everyone HOLDS the overflow request back (FIFO:
+    it completes later, correctly) and the queue bound sheds with a typed
+    ServeBusy; a request that can NEVER fit is rejected up front."""
+    model, params = lm
+    # 3 usable pages; a 10-prompt/8-new request reserves all 3, so slots
+    # (max_batch=3) are plentiful but pages admit ONE request at a time.
+    cfg = ServeConfig(max_batch=3, page_len=8, kv_pages=4, max_queue=2,
+                      prefix_cache=False)
+    engine = PagedLMEngine(model, params, cfg)
+    b = Batcher(engine, cfg, start=False)
+    reqs = [b.submit(_prompt(10, seed=31), 8, seed=0)]
+    b.run_once()
+    assert engine.num_active == 1
+    # Two more park behind the page budget (slots are free; pages are not)
+    # and fill the queue; the next submit sheds with a typed ServeBusy.
+    reqs += [b.submit(_prompt(10, seed=32 + i), 8, seed=1 + i)
+             for i in range(2)]
+    b.run_once()
+    assert engine.num_active == 1
+    # run_once parked the head-of-line request in the batcher's held slot,
+    # freeing one queue position — one more filler refills the bound.
+    reqs.append(b.submit(_prompt(4, seed=40), 4, seed=9))
+    with pytest.raises(ServeBusy):
+        b.submit(_prompt(4, seed=41), 4, seed=10)
+    _drive(b, reqs)
+    assert all(r.error is None for r in reqs)
+    # Impossible request: needs more pages than the pool owns -> typed
+    # rejection at admission, not head-of-line blocking.
+    doomed = b.submit(_prompt(20, seed=42), 12, seed=11)
+    b.run_once()
+    assert doomed.done.is_set() and "KV pages" in (doomed.error or "")
+    assert engine._alloc.available() == engine._alloc.usable  # ledger clean
+
+
+# ------------------------------------------------------------- router units
+
+class FakeEngine:
+    """Deterministic jax-free engine (the test_batched_serving pattern):
+    token = 100*slot + step index; optional per-step delay so requests stay
+    in flight long enough to be killed mid-generation."""
+
+    def __init__(self, capacity=2, max_len=32, step_s=0.0):
+        self.capacity = capacity
+        self.max_len = max_len
+        self.buckets = default_buckets(max_len)
+        self.admits = []
+        self._steps = np.zeros(capacity, np.int64)
+        self._step_s = step_s
+
+    def make_keys(self, seed, n):
+        return None
+
+    def admit(self, slot, prompt, key):
+        self.admits.append((slot, int(prompt.size)))
+        self._steps[slot] = 0
+        return 100 * slot
+
+    def step(self, keys):
+        if self._step_s:
+            time.sleep(self._step_s)
+        self._steps += 1
+        return (100 * np.arange(self.capacity) + self._steps).astype(np.int32)
+
+    def free(self, slot):
+        pass
+
+
+def _replica_factory(capacity=2, max_queue=8, step_s=0.0, engines=None):
+    def factory():
+        engine = FakeEngine(capacity=capacity, step_s=step_s)
+        if engines is not None:
+            engines.append(engine)
+        b = Batcher(engine, ServeConfig(max_batch=capacity,
+                                        max_queue=max_queue))
+        return InferenceServer(b, port=0)
+    return factory
+
+
+@pytest.fixture
+def clean_fleet_state():
+    _recovery.reset()
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_router_routes_and_spreads(clean_fleet_state):
+    """Basic routing through RouterServer with an UNCHANGED ServeClient,
+    least-loaded spread across both replicas under concurrency."""
+    engines = []
+    router = Router(_replica_factory(step_s=0.002, engines=engines),
+                    n_replicas=2, start=False)
+    server = RouterServer(router)
+    routed0 = _counter("serve.router.routed")
+    try:
+        tokens, timing = ServeClient(server.address).generate(
+            np.arange(1, 5), 3, seed=0)
+        assert tokens.tolist() == [0, 1, 2]      # slot 0, steps 1..2
+        assert "total_s" in timing
+        results, errors = [], []
+
+        def one(i):
+            try:
+                results.append(ServeClient(server.address).generate(
+                    np.arange(1, 4), 4, seed=i)[0].tolist())
+            except Exception as e:   # noqa: BLE001 - the assert reports it
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors and len(results) == 8
+        assert _counter("serve.router.routed") - routed0 == 9
+        # Least-loaded spread: with 8 concurrent requests over 2x capacity-2
+        # replicas, both must have admitted work.
+        assert all(engine.admits for engine in engines)
+    finally:
+        server.close()
+
+
+def test_router_sheds_typed_busy_when_all_replicas_full(clean_fleet_state):
+    """Both replicas' queues full -> the cascade tries everyone, then the
+    router replies a typed ServeBusy instantly (serve.router.shed books).
+    Deterministic: the replica batchers are never started, so their queues
+    fill and stay full."""
+    servers = []
+
+    def factory():
+        b = Batcher(FakeEngine(capacity=1), ServeConfig(max_batch=1,
+                                                        max_queue=1),
+                    start=False)
+        server = InferenceServer(b, port=0)
+        servers.append(server)
+        return server
+
+    router = Router(factory, n_replicas=2, start=False)
+    server = RouterServer(router)
+    shed0 = _counter("serve.router.shed")
+    try:
+        # Fill each replica's (unserviced) queue directly.
+        for rep in servers:
+            rep._batcher.submit(np.arange(1, 3), 2, seed=0)
+        client = ServeClient(server.address)
+        with pytest.raises(ServeBusy):
+            client.generate(np.arange(1, 3), 2, seed=1)
+        assert _counter("serve.router.shed") - shed0 == 1
+    finally:
+        server.close()
+
+
+def test_kill_a_replica_completes_all_requests_zero_failures(
+        clean_fleet_state, monkeypatch):
+    """The PR's recovery acceptance: kill a replica with requests in flight;
+    every request completes (replayed on a survivor with the SAME rid),
+    zero client-visible failures, and the recovery plane books >= 1
+    eviction + respawn + rejoin; the respawned replica carries a bumped
+    generation and serves traffic."""
+    monkeypatch.setattr(Router, "RESPAWN_BACKOFF_S", 0.02)
+    router = Router(_replica_factory(step_s=0.01), n_replicas=2, start=False)
+    server = RouterServer(router)
+    replayed0 = _counter("serve.router.replayed")
+    try:
+        victim = router.replicas()[0]
+
+        def killer():
+            deadline = time.monotonic() + 5.0
+            while victim.in_flight == 0 and time.monotonic() < deadline:
+                time.sleep(0.001)
+            victim.server.kill()
+
+        results, errors = [], []
+
+        def one(i):
+            try:
+                results.append(ServeClient(server.address).generate(
+                    np.arange(1, 4), 8, seed=i)[0].tolist())
+            except Exception as e:   # noqa: BLE001 - the assert reports it
+                errors.append(repr(e))
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        kt.join()
+        assert errors == []                       # ZERO client-visible failures
+        assert len(results) == 6
+        assert _counter("serve.router.replayed") > replayed0
+        counts = _recovery.recovery_snapshot()["counts"]
+        assert counts["evicted"] >= 1
+        assert counts["respawns"] >= 1
+        assert counts["rejoined"] >= 1
+        live = [r for r in router.replicas() if not r.down]
+        assert len(live) == 2                     # the fleet healed
+        assert max(r.generation for r in live) == 1
+        # The healed fleet serves.
+        tokens, _ = ServeClient(server.address).generate(
+            np.arange(1, 3), 2, seed=99)
+        assert len(tokens) == 2
+    finally:
+        server.close()
+
+
+def test_rid_dedup_replay_is_idempotent(clean_fleet_state):
+    """GL011 at the replica: re-sending a completed request-id returns the
+    CACHED reply without re-generating (one admit), so the router's replay
+    after a replica death can never double-generate."""
+    engine = FakeEngine(capacity=1)
+    server = InferenceServer(Batcher(engine, ServeConfig(max_batch=1)),
+                             port=0)
+    try:
+        from autodist_tpu.parallel.ps_transport import _PSClient
+        client = _PSClient(server.address, connect_timeout=10.0)
+        try:
+            prompt = np.arange(1, 4).astype(np.int32)
+            first = client.call("generate", prompt, 3, 0, None, "rid-x")
+            again = client.call("generate", prompt, 3, 0, None, "rid-x")
+            assert np.array_equal(first[0], again[0])
+            assert first[1] == again[1]           # cached timing, same reply
+            assert len(engine.admits) == 1        # generated ONCE
+        finally:
+            client.close()
+    finally:
+        server.close()
+
+
+def test_router_drains_and_scales_out_on_alert(clean_fleet_state,
+                                               monkeypatch):
+    """serve_p99_burn active on a replica -> poll_once drains it (no new
+    routes) and scales out on the respawn budget; the alert clearing
+    rejoins it."""
+    monkeypatch.setattr(Router, "RESPAWN_BACKOFF_S", 0.01)
+    router = Router(_replica_factory(), n_replicas=2, start=False)
+    try:
+        burning = router.replicas()[0]
+        real_call = burning.call
+        burn_status = {"alerts": {"active": [{"rule": "serve_p99_burn"}]}}
+        burning.call = lambda op, *a: (burn_status,) if op == "status" \
+            else real_call(op, *a)
+        router.poll_once()
+        assert burning.draining
+        assert len(router.replicas()) == 3        # scaled out
+        assert router._pick([]) is not burning    # no new routes while draining
+        counts = _recovery.recovery_snapshot()["counts"]
+        assert counts["rejoined"] >= 1            # the scale-out replica
+        # Alert clears -> the drained replica rejoins the rotation.
+        burning.call = real_call
+        router.poll_once()
+        assert not burning.draining
+        # Scale-out is bounded: every further poll with the alert active
+        # must not exceed max_replicas.
+        burning.call = lambda op, *a: (burn_status,) if op == "status" \
+            else real_call(op, *a)
+        for _ in range(router.max_replicas + 2):
+            router.poll_once()
+        assert len(router.replicas()) <= router.max_replicas
+    finally:
+        router.close()
+
+
+def test_fault_hook_kills_replica_deterministically(clean_fleet_state,
+                                                    monkeypatch):
+    """testing/faults.py drives the SAME kill path deterministically: a
+    worker_crash point matched on the router's request sequence kills the
+    chosen replica before forwarding; the request still completes via
+    replay. This is the bench's kill-a-replica mechanism."""
+    monkeypatch.setattr(Router, "RESPAWN_BACKOFF_S", 0.02)
+    router = Router(_replica_factory(), n_replicas=2, start=False)
+    server = RouterServer(router)
+    try:
+        faults.install("worker_crash@step=1")
+        client = ServeClient(server.address)
+        t0 = client.generate(np.arange(1, 3), 2, seed=0)[0]   # seq 0: clean
+        t1 = client.generate(np.arange(1, 3), 2, seed=1)[0]   # seq 1: killed
+        assert len(t0) == 2 and len(t1) == 2
+        counts = _recovery.recovery_snapshot()["counts"]
+        assert counts["evicted"] == 1 and counts["respawns"] == 1
+    finally:
+        server.close()
+
+
+def test_respawn_policy_budget_and_booking(clean_fleet_state):
+    """RespawnPolicy (the coordinator's discipline, shared with the router):
+    AUTODIST_RECOVER_MAX grants per key, each booked as recover.respawn,
+    then None (the caller escalates)."""
+    policy = RespawnPolicy(base_s=0.0, cap_s=0.0)
+    budget = policy.budget()
+    delays = [policy.grant("10.0.0.9:7000") for _ in range(budget)]
+    assert all(d is not None for d in delays)
+    assert policy.grant("10.0.0.9:7000") is None      # budget spent
+    assert policy.grant("10.0.0.8:7000") is not None  # per-key ledger
+    assert _recovery.recovery_snapshot()["counts"]["respawns"] == budget + 1
+
+
+def test_fleet_flags_registered():
+    """GL007's runtime face: the new flags resolve through const.ENV with
+    their documented defaults."""
+    from autodist_tpu import const
+    for name in ("AUTODIST_SERVE_REPLICAS", "AUTODIST_KV_PAGE_LEN",
+                 "AUTODIST_PREFIX_CACHE", "AUTODIST_ROUTER_ADDR"):
+        assert name in const.KNOWN_FLAGS
+    assert int(const.ENV.AUTODIST_SERVE_REPLICAS.val) == 2
+    assert int(const.ENV.AUTODIST_KV_PAGE_LEN.val) == 0
+    assert bool(const.ENV.AUTODIST_PREFIX_CACHE.val) is True
+
+
+def test_router_status_renders_in_consoles(clean_fleet_state):
+    """The kind="router" status payload renders a replica table in adtop
+    and a replicas/shed row in adfleet (the PR's console satellite)."""
+    router = Router(_replica_factory(), n_replicas=2, start=False)
+    server = RouterServer(router)
+    try:
+        status = ServeClient(server.address).status()
+        assert status["kind"] == "router"
+        assert len(status["replicas"]) == 2
+        import importlib.util
+        import os
+        for tool in ("adtop", "adfleet"):
+            spec = importlib.util.spec_from_file_location(
+                tool, os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "tools", f"{tool}.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            if tool == "adtop":
+                screen = mod.render(status, "x:1")
+                assert "router   routed" in screen
+                assert "replica" in screen
+            else:
+                screen = mod.render({"x:1": status})
+                assert "replicas 2/2 up" in screen
+    finally:
+        server.close()

@@ -117,6 +117,27 @@ def _row(address: str, status: dict) -> str:
         if isinstance(total, dict):
             cols.append(f"p50 {_fmt_q(_metrics.quantile(total, 0.5))} "
                         f"p99 {_fmt_q(_metrics.quantile(total, 0.99))}")
+        used = reg.get("serve.kv.pages_used")
+        free = reg.get("serve.kv.pages_free")
+        if isinstance(used, (int, float)) or isinstance(free, (int, float)):
+            # Paged-KV occupancy fingerprint (dense-slab replicas keep the
+            # column off, like recov/wiresave).
+            cols.append(f"pages {int(used or 0)}/"
+                        f"{int(used or 0) + int(free or 0)}")
+    elif kind == "router":
+        replicas = status.get("replicas") or []
+        n_up = sum(1 for r in replicas
+                   if not r.get("down") and not r.get("draining"))
+        cols.append(f"replicas {n_up}/{len(replicas)} up")
+        shed = reg.get("serve.router.shed")
+        routed = reg.get("serve.router.routed")
+        if isinstance(routed, (int, float)):
+            cols.append(f"routed {int(routed)}")
+        if isinstance(shed, (int, float)) and shed:
+            # Admission sheds are the router's overload fingerprint: a
+            # nonzero column is the signal to raise max_replicas or shrink
+            # the offered load, BEFORE p99 melts.
+            cols.append(f"shed {int(shed)}")
     active = (status.get("alerts") or {}).get("active") or []
     if active:
         cols.append("ALERT " + ",".join(sorted(a.get("rule", "?")

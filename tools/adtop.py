@@ -244,6 +244,16 @@ def render(status: dict, address: str = "") -> str:
                 f"slo      done {done or 0}  rejected {rej or 0}  "
                 f"p50~ {f'{p50:.4g}' if p50 is not None else '-'}s  "
                 f"p99~ {f'{p99:.4g}' if p99 is not None else '-'}s")
+        used = _counter(reg, "serve.kv.pages_used")
+        free = _counter(reg, "serve.kv.pages_free")
+        if used is not None or free is not None:
+            # Paged-KV plane (serving/paged.py): pool occupancy + the
+            # prefix-cache hit ledger. Absent on a dense-slab engine.
+            hits = _counter(reg, "serve.kv.prefix_hits") or 0
+            misses = _counter(reg, "serve.kv.prefix_misses") or 0
+            lines.append(f"kv       pages {int(used or 0)} used / "
+                         f"{int(free or 0)} free  "
+                         f"prefix hits {int(hits)} misses {int(misses)}")
         if in_flight:
             lines.append("request  slot   age  tokens  prompt")
             for r in in_flight:
@@ -252,6 +262,22 @@ def render(status: dict, address: str = "") -> str:
                              f"{_fmt_age(r.get('age_s', 0)):>5}  "
                              f"{r.get('tokens', 0):>6}  "
                              f"{r.get('prompt_len', 0):>6}")
+    elif kind == "router":
+        routed = _counter(reg, "serve.router.routed") or 0
+        shed = _counter(reg, "serve.router.shed") or 0
+        replayed = _counter(reg, "serve.router.replayed") or 0
+        lines.append(f"router   routed {int(routed)}  shed {int(shed)}  "
+                     f"replayed {int(replayed)}")
+        replicas = status.get("replicas") or []
+        if replicas:
+            lines.append("replica              gen  in-flight  queue  state")
+            for r in replicas:
+                state = "down" if r.get("down") else (
+                    "draining" if r.get("draining") else "up")
+                lines.append(f"  {r.get('replica', '?'):<18} "
+                             f"{r.get('generation', 0)!s:>4} "
+                             f"{r.get('in_flight', 0)!s:>10} "
+                             f"{r.get('queue_depth', 0)!s:>6}  {state}")
     lines.extend(_perf_lines(reg))
     lines.extend(_health_lines(reg))
     lines.extend(_alert_lines(status.get("alerts") or {}))
