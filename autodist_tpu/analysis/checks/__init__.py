@@ -15,8 +15,9 @@ Each module registers with :func:`autodist_tpu.analysis.core.register`
 - metrics_registry: GL009 metric/event-name registry (program)
 - resources:        GL010 resource-close discipline (program)
 - wire_idempotency: GL011 wire-retry idempotency contract (program)
+- races:            GL012 guarded-field consistency (program)
 """
 
 from autodist_tpu.analysis.checks import (  # noqa: F401
-    concurrency, donation, envflags, metrics_registry, resources,
+    concurrency, donation, envflags, metrics_registry, races, resources,
     testlayout, tracer, wire_idempotency, wire_protocol)

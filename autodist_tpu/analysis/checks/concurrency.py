@@ -24,7 +24,12 @@ from autodist_tpu.analysis.core import (Context, Finding, Module, register,
 
 _LOCK_TOKENS = {"lock", "rlock", "mutex", "mtx", "cond", "condition",
                 "sem", "semaphore"}
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# The san_* names are testing/sanitizer.py's env-armed factories — disarmed
+# they return the bare primitive, so a `self._lock = san_lock()` site is a
+# lock definition exactly like `threading.Lock()` and must stay visible to
+# _definite_locks (factory adoption must not blind GL001/GL002/GL012).
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+               "san_lock", "san_rlock", "san_condition"}
 _DISPATCH_ATTRS = {"block_until_ready", "device_put", "device_get",
                    "sendall", "sendmsg", "sendto", "recv", "recv_into",
                    "recvfrom", "recvmsg", "connect", "accept"}
@@ -373,6 +378,132 @@ def check_lock_order(program, ctx: Context) -> List[Finding]:
                     scope=scope))
             seen.setdefault((outer, inner), node)
     return findings
+
+
+def static_lock_edges(program) -> Dict[Tuple[Tuple[str, str],
+                                             Tuple[str, str]],
+                                       Tuple[str, int]]:
+    """Every identity-resolved static lock-order edge, for ``--crosscheck``:
+    ``{((outer relpath, outer name), (inner relpath, inner name)):
+    (reporting module, line)}``. Same harvest GL002 runs on, restricted to
+    edges whose both endpoints resolve to a definition site — the only ones
+    a runtime observation can be matched against."""
+    definite_by_module = {info.relpath: _definite_locks(info.module.tree)
+                          for info in program.modules()}
+    edges: Dict[Tuple[Tuple[str, str], Tuple[str, str]],
+                Tuple[str, int]] = {}
+    for info in program.modules():
+        definite = definite_by_module[info.relpath]
+        for (_outer, _inner, node, _sub, outer_id, inner_id) \
+                in _nested_lock_edges(program, info, definite,
+                                      definite_by_module):
+            if outer_id is None or inner_id is None:
+                continue
+            edges.setdefault((outer_id, inner_id),
+                             (info.relpath, node.lineno))
+    return edges
+
+
+def _fmt_site(key) -> str:
+    path, name, cls = key
+    return f"{path}:{name}" + (f" ({cls})" if cls else "")
+
+
+def crosscheck(program, observed: List[dict]) \
+        -> Tuple[List[Finding], List[dict]]:
+    """Merge sanitizer-observed lock-order edges into GL002's static graph.
+
+    ``observed`` is the parsed edge records from
+    ``.graftlint_cache/observed_locks.jsonl`` (``testing/sanitizer.py``
+    export): ``{"outer": {"path", "name", "cls"}, "inner": {...},
+    "count": n}``. Site keys align with GL002's lock identities by
+    construction — the sanitizer keys a lock by its creation site's
+    ``(repo-relative path, assignment lhs)``, the same ``(relpath,
+    "self._lock")`` pair ``_lock_identity`` resolves.
+
+    Returns ``(findings, unexercised)``:
+
+    - a cycle in the MERGED observed digraph is a finding — each in-process
+      run aborts on its own cycles, so one surviving the merge is
+      dynamic-only evidence spanning runs/processes that no single
+      execution (and no static identity edge) could show;
+    - an observed edge whose reverse direction exists as a static identity
+      edge is a finding — the runtime took the locks in the opposite order
+      the code's static nesting establishes (ABBA with one half dynamic);
+    - a static identity edge never observed is returned in ``unexercised``
+      (informational): the lock model has coverage the test run didn't
+      earn, the same way an untested branch reads.
+    """
+    static = static_lock_edges(program)
+    static_pairs = {((o[0], o[1]), (i[0], i[1])): loc
+                    for (o, i), loc in static.items()}
+
+    def nkey(d: dict):
+        return (d.get("path", "?"), d.get("name", "?"), d.get("cls"))
+
+    adj: Dict[tuple, Set[tuple]] = {}
+    obs_edges: Set[Tuple[tuple, tuple]] = set()
+    for rec in observed:
+        o, i = nkey(rec["outer"]), nkey(rec["inner"])
+        adj.setdefault(o, set()).add(i)
+        obs_edges.add((o, i))
+
+    findings: List[Finding] = []
+
+    # (a) cycles in the merged observed digraph.
+    color: Dict[tuple, int] = {}
+    stack: List[tuple] = []
+    seen_cycles: Set[frozenset] = set()
+
+    def dfs(u):
+        color[u] = 1
+        stack.append(u)
+        for v in sorted(adj.get(u, ()), key=str):
+            c = color.get(v, 0)
+            if c == 0:
+                dfs(v)
+            elif c == 1:
+                cyc = stack[stack.index(v):] + [v]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    findings.append(Finding(
+                        "GL002", cyc[0][0], 1, 0,
+                        "crosscheck: observed lock-order cycle "
+                        + " -> ".join(_fmt_site(n) for n in cyc)
+                        + " in the merged runtime edges; no single "
+                        "acquisition order exists — a dynamic-only "
+                        "deadlock the static graph cannot see",
+                        scope=None))
+        stack.pop()
+        color[u] = 2
+
+    for u in sorted(adj, key=str):
+        if color.get(u, 0) == 0:
+            dfs(u)
+
+    # (b) observed edges contradicting a static identity edge.
+    for o, i in sorted(obs_edges, key=str):
+        loc = static_pairs.get(((i[0], i[1]), (o[0], o[1])))
+        if loc is not None:
+            rel, line = loc
+            findings.append(Finding(
+                "GL002", rel, line, 0,
+                f"crosscheck: runtime acquired {_fmt_site(i)} while "
+                f"holding {_fmt_site(o)}, the opposite of the static "
+                f"nesting established here — an ABBA deadlock with one "
+                f"half only reachable dynamically",
+                scope=None))
+
+    # (c) static identity edges the run never exercised.
+    observed_pairs = {((o[0], o[1]), (i[0], i[1])) for o, i in obs_edges}
+    unexercised = [
+        {"outer": {"path": okey[0], "name": okey[1]},
+         "inner": {"path": ikey[0], "name": ikey[1]},
+         "path": rel, "line": line}
+        for (okey, ikey), (rel, line) in sorted(static.items())
+        if (okey, ikey) not in observed_pairs]
+    return findings, unexercised
 
 
 @register("GL005", "unbounded blocking wait in runtime code")

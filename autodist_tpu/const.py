@@ -172,6 +172,12 @@ KNOWN_FLAGS = {
                        "tests/bench (testing/faults.py grammar: "
                        "'worker_crash@step=3,worker=1;nan_grads@step=5'); "
                        "empty = disarmed",
+    "AUTODIST_SANITIZE": "runtime concurrency sanitizer (testing/"
+                         "sanitizer.py): comma-set of 'locks' (lock-order "
+                         "graph + dynamic deadlock-cycle detection), 'waits' "
+                         "(unbounded/lock-holding waits), 'threads' "
+                         "(non-daemon thread-leak fence); empty = disarmed "
+                         "(san_lock() returns bare primitives)",
     "AUTODIST_WIRE_DTYPE": "quantized PS gradient push: 'fp16', 'bf16' or "
                            "'int8' compresses eligible gradient leaves on "
                            "the wire (error feedback keeps convergence); "
@@ -349,6 +355,12 @@ _ENV_DEFAULTS = {
     "AUTODIST_WIRE_RETRIES": 2,
     "AUTODIST_WIRE_BACKOFF_S": 0.2,
     "AUTODIST_FAULTS": "",
+    # Runtime concurrency sanitizer (autodist_tpu/testing/sanitizer.py):
+    # comma-set of modes ('locks', 'waits', 'threads'). Disarmed (the
+    # default) the san_lock()/san_rlock()/san_condition()/san_event()
+    # factories return bare threading primitives — hot-path cost is one
+    # module-global check at CREATION time, zero per acquire.
+    "AUTODIST_SANITIZE": "",
     # Wire-compression plane (parallel/synchronization.WirePushCompressor):
     # quantized gradient pushes with error feedback plus sparse top-k pushes
     # for row-sparse params. WIRE_DTYPE empty = exact pushes (the tuned
@@ -425,6 +437,7 @@ class ENV(enum.Enum):
     AUTODIST_WIRE_RETRIES = "AUTODIST_WIRE_RETRIES"
     AUTODIST_WIRE_BACKOFF_S = "AUTODIST_WIRE_BACKOFF_S"
     AUTODIST_FAULTS = "AUTODIST_FAULTS"
+    AUTODIST_SANITIZE = "AUTODIST_SANITIZE"
     AUTODIST_WIRE_DTYPE = "AUTODIST_WIRE_DTYPE"
     AUTODIST_COMPRESS_MIN_BYTES = "AUTODIST_COMPRESS_MIN_BYTES"
     AUTODIST_SPARSE_PUSH = "AUTODIST_SPARSE_PUSH"

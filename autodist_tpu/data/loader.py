@@ -39,8 +39,9 @@ import numpy as np
 
 from autodist_tpu import const
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock, san_condition
 
-_BUILD_LOCK = threading.Lock()
+_BUILD_LOCK = san_lock()
 _LIB = None
 _LIB_FAILED = False
 
@@ -228,7 +229,7 @@ class DataLoader:
         # freed memory. The condition tracks in-flight native calls: close()
         # flips `_closing` (new next() calls fail fast) and waits (bounded)
         # for the in-flight count to drain before destroying.
-        self._native_cv = threading.Condition()
+        self._native_cv = san_condition()
         self._native_inflight = 0
         self._closing = False
         self._handle = None

@@ -41,6 +41,7 @@ import numpy as np
 
 from autodist_tpu import const, telemetry
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock, san_condition, san_event
 
 __all__ = ["BoundedQueue", "QueueClosed", "EMPTY", "PrefetchProducer",
            "prefetch_to_device", "host_shard_rows", "host_shard",
@@ -97,7 +98,7 @@ class BoundedQueue:
                              f"{capacity}")
         self.capacity = int(capacity)
         self._items: collections.deque = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = san_condition()
         self._closed = False
 
     def __len__(self) -> int:
@@ -251,11 +252,11 @@ class PrefetchProducer:
         self._transform = transform
         self._queue = BoundedQueue(depth)
         self._prefix = metric_prefix
-        self._src_lock = threading.Lock()
-        self._turn = threading.Condition()
+        self._src_lock = san_lock()
+        self._turn = san_condition()
         self._next_seq = 0        # next pull sequence (under _src_lock)
         self._next_emit = 0       # next sequence allowed to emit (under _turn)
-        self._stop = threading.Event()
+        self._stop = san_event()
         self._src_done = False    # producer side: no more pulls (under _src_lock)
         self._consumer_done = False
         self._wait_c = telemetry.counter(f"{metric_prefix}.producer_wait")

@@ -65,6 +65,7 @@ from autodist_tpu.parallel import wire
 from autodist_tpu.testing import faults as _faults
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import WireCounters
+from autodist_tpu.testing.sanitizer import san_lock, san_event
 
 PyTree = Any
 
@@ -89,7 +90,7 @@ _IOV_BATCH = 64
 # cluster.
 _TR_LIB = None
 _TR_FAILED = False
-_TR_LOCK = threading.Lock()
+_TR_LOCK = san_lock()
 
 
 def _native_transport():
@@ -412,7 +413,7 @@ class _StragglerWatchdog:
         # NORMAL steady-state gating, so the flag needs persistence (the
         # same STALL_INTERVALS the silence check uses) before it fires.
         self._straggler_ticks: dict = {}
-        self._stop = threading.Event()
+        self._stop = san_event()
         self.flagged: set = set()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ps-watchdog")
@@ -533,7 +534,7 @@ class PSServer:
         # Span rings workers deposited over the `push_trace` opcode, keyed by
         # worker id — the chief-side half of telemetry.collect_cluster_trace.
         self._worker_traces: dict = {}
-        self._trace_lock = threading.Lock()
+        self._trace_lock = san_lock()
         # Aggregate wire accounting across every connection this server has
         # handled (payload bytes, message counts, encode/decode time) —
         # surfaced in the async-PS log line and summarized at close().
@@ -543,7 +544,7 @@ class PSServer:
         # `stats` opcode and printed at close() next to each worker's
         # staleness histogram.
         self._worker_stats: dict = {}
-        self._worker_stats_lock = threading.Lock()
+        self._worker_stats_lock = san_lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -994,7 +995,7 @@ class _PSClient:
         self._backoff_s = max(0.0,
                               float(const.ENV.AUTODIST_WIRE_BACKOFF_S.val))
         self._sock = self._connect(self._connect_timeout)
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._pool = _RecvBuffer()
         # Wire accounting (payload bytes/messages both directions + codec
         # time) — lets callers and tests measure what a protocol change

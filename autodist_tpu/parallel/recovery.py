@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional
 
 from autodist_tpu import const
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["SnapshotRing", "evict", "rollback", "backoff_s",
            "log_eviction", "log_rejoin", "log_rollback", "log_respawn",
@@ -76,7 +77,7 @@ class _RecoveryLog:
     category; total counts survive the deque bound."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._evictions = collections.deque(maxlen=KEEP_RECORDS)
         self._rejoins = collections.deque(maxlen=KEEP_RECORDS)
         self._rollbacks = collections.deque(maxlen=KEEP_RECORDS)

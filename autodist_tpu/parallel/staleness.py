@@ -40,6 +40,7 @@ from autodist_tpu.runner import DistributedRunner, TrainState
 from autodist_tpu.testing import faults as _faults
 from autodist_tpu.telemetry.metrics import COUNT_BUCKETS, Histogram
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock, san_condition
 
 PyTree = Any
 
@@ -133,7 +134,7 @@ class StalenessController:
         # that observed an OLD occupant of a slot retire conditionally, so a
         # stale socket's death can never retire the live replacement.
         self._generation: dict = {}
-        self._cond = threading.Condition()
+        self._cond = san_condition()
 
     @property
     def steps(self):
@@ -348,7 +349,7 @@ class ParameterService:
         # A Condition, not a bare Lock: read_min (the overlapped transport
         # client's prefetch) waits on version advancement; every state
         # replacement notifies. `with self._lock:` works unchanged.
-        self._lock = threading.Condition()
+        self._lock = san_condition()
         # Serializes WRITERS (apply/reset/adopt) separately from the snapshot
         # Condition above: the gradient application's device execution runs
         # under only this mutex, so readers (read/read_if_newer/read_min —
@@ -357,7 +358,7 @@ class ParameterService:
         # reverse — declared for graftlint so an inverted path fails lint
         # (GL002) instead of deadlocking a chief under load.
         # graftlint: lock-order=_write_mutex->_lock
-        self._write_mutex = threading.Lock()
+        self._write_mutex = san_lock()
         # Generation counter: bumps on EVERY state replacement (apply, reset,
         # adopt) and is never reused, so version equality implies state
         # identity — the contract read_if_newer's "not modified" answer (and
@@ -512,7 +513,7 @@ class ShardedParameterService(ParameterService):
         self._params_order = list(self._params_flat)  # flatten order == names order
         self._assign = _assign_shards(self._params_flat, shards)
         self.shards = len(self._assign)
-        self._shard_mutex = [threading.Lock() for _ in self._assign]
+        self._shard_mutex = [san_lock() for _ in self._assign]
         self._shard_version = [0] * self.shards
         self._opt_template = state.opt_state
         self._shard_opt = [
@@ -849,7 +850,7 @@ class AsyncPSRunner(DistributedRunner):
         # the (jitted) sync step_fn, so compile it here.
         self._jit_grad_fn = jax.jit(self._grad_fn)
         self._workers = {i: AsyncWorker(self, i) for i in range(self.num_workers)}
-        self._membership_lock = threading.Lock()  # add_worker bookkeeping
+        self._membership_lock = san_lock()  # add_worker bookkeeping
         # Serializes multi-device program EXECUTION (dispatch + completion)
         # across this process's threads: two concurrently executing programs
         # that both carry cross-replica collectives can interleave their
@@ -859,8 +860,8 @@ class AsyncPSRunner(DistributedRunner):
         # execution is holding). In-process async workers time-share one mesh
         # anyway — real concurrency lives across processes, whose devices are
         # disjoint — so the serialization costs ordering, not parallelism.
-        self._collective_lock = threading.Lock()
-        self._dump_lock = threading.Lock()
+        self._collective_lock = san_lock()
+        self._dump_lock = san_lock()
         self._dumped = False
         self._placer = None
         logging.info("AsyncPSRunner: %d worker(s), staleness=%s%s",

@@ -47,6 +47,7 @@ from autodist_tpu import telemetry
 # the prefetch producers and the serving batchers. data.prefetch stays
 # jax-free at import, preserving this module's jax-free contract.
 from autodist_tpu.data.prefetch import EMPTY, BoundedQueue, QueueClosed
+from autodist_tpu.testing.sanitizer import san_lock, san_event
 
 
 class ServeError(RuntimeError):
@@ -173,7 +174,7 @@ class ServeRequest:
         self.t_admit = 0.0
         self.t_prefill_done = 0.0
         self.t_done = 0.0
-        self.done = threading.Event()
+        self.done = san_event()
         self.tokens: List[int] = []       # generated ids (LM path)
         self.output = None                # model output (apply path)
         self.error: Optional[str] = None
@@ -256,12 +257,12 @@ class _BatcherBase:
         self._engine = engine
         self.config = config
         self._metrics = _ServeMetrics()
-        self._lock = threading.Lock()          # slot/engine state
+        self._lock = san_lock()          # slot/engine state
         # Admission staging on the shared input-plane queue core: bounded
         # (max_queue), instant-rejection try_put, close-and-drain shutdown.
         self._waiting = BoundedQueue(config.max_queue)
         self._rid = itertools.count()
-        self._stop = threading.Event()
+        self._stop = san_event()
         self._thread: Optional[threading.Thread] = None
         self._thread_name = thread_name
 
@@ -384,8 +385,10 @@ class Batcher(_BatcherBase):
         # engine cannot admit YET (paged engines gate on free pages, not
         # free slots) parks here and is retried FIRST next round —
         # BoundedQueue has no push-front, and skipping it would reorder
-        # FIFO admission. Only the scheduler thread touches it (close()
-        # collects it after the join, under _lock, via _inflight_locked).
+        # FIFO admission. Guarded by _lock: _admit_ready swaps it out and
+        # restores it under the lock, and close() collects it via
+        # _inflight_locked — if join(30) times out the scheduler thread is
+        # still live, so the bare-access version raced.
         self._held: Optional[ServeRequest] = None
         if start:
             self._start()
@@ -510,12 +513,19 @@ class Batcher(_BatcherBase):
         device."""
         now = time.perf_counter()
         dropped: List[ServeRequest] = []
+        # _held is shared with close() (which collects it under _lock via
+        # _inflight_locked, and may run concurrently if join(30) expires):
+        # swap it out under the lock, work on the local, and restore any
+        # held-back request under the same lock that publishes the batch.
         with self._lock:
             free = [s for s, r in enumerate(self._slots) if r is None]
             n_slots = len(self._slots)
-        if (self._held is None and not len(self._waiting)) or not free:
-            return
-        if self.config.mode == "static" and len(free) != n_slots:
+            held, self._held = self._held, None
+        if ((held is None and not len(self._waiting)) or not free
+                or (self.config.mode == "static" and len(free) != n_slots)):
+            if held is not None:
+                with self._lock:
+                    self._held = held
             return
         # Paged engines expose can_admit(prompt_len, max_new) — admission
         # gates on RESERVABLE PAGES, not free slots. A request that cannot
@@ -525,8 +535,8 @@ class Batcher(_BatcherBase):
         can_admit = getattr(self._engine, "can_admit", None)
         batch: List[Tuple[int, ServeRequest]] = []
         while free:
-            if self._held is not None:
-                req, self._held = self._held, None
+            if held is not None:
+                req, held = held, None
             else:
                 req = self._waiting.pop_nowait()
                 if req is EMPTY:
@@ -542,11 +552,13 @@ class Batcher(_BatcherBase):
                     self._metrics.rejected.inc()
                     continue
                 if not ok:
-                    self._held = req
+                    held = req
                     break
             batch.append((free.pop(0), req))
         self._metrics.depth.set(len(self._waiting))
         with self._lock:
+            if held is not None:
+                self._held = held
             for slot, req in batch:
                 self._slots[slot] = req
         for req in dropped:

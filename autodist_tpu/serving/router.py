@@ -47,6 +47,7 @@ from autodist_tpu.serving.transport import _wire_server
 from autodist_tpu.testing import faults as _faults
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import WireCounters
+from autodist_tpu.testing.sanitizer import san_lock, san_event
 
 # The burn-rate alert that triggers drain + scale-out (telemetry/alerts.py
 # DEFAULT_RULES ships it over serve.latency_s.total).
@@ -70,12 +71,71 @@ class Replica:
                              else address)
         self.name = "%s:%d" % self.address
         self.generation = generation
+        # Routing state below is written by request threads (in_flight) and
+        # the supervisor (down/draining/last_status) while pickers and
+        # snapshots read it — every access goes through _lock via the
+        # accessors; name/generation/address are immutable after __init__.
         self.in_flight = 0
         self.down = False
         self.draining = False
         self.last_status: dict = {}
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._idle: List[_PSClient] = []
+
+    # ------------------------------------------------- routing-state access
+
+    def routable(self) -> bool:
+        with self._lock:
+            return not self.down and not self.draining
+
+    def load(self) -> int:
+        with self._lock:
+            return self.in_flight
+
+    def is_down(self) -> bool:
+        with self._lock:
+            return self.down
+
+    def mark_down(self) -> bool:
+        """Set ``down``; True exactly once (the caller that books the
+        eviction and respawns)."""
+        with self._lock:
+            if self.down:
+                return False
+            self.down = True
+            return True
+
+    def begin_drain(self) -> bool:
+        """Set ``draining``; True exactly once per drain episode."""
+        with self._lock:
+            if self.draining:
+                return False
+            self.draining = True
+            return True
+
+    def end_drain(self) -> bool:
+        """Clear ``draining``; True if this call cleared it."""
+        with self._lock:
+            if not self.draining:
+                return False
+            self.draining = False
+            return True
+
+    def note_status(self, st: dict):
+        with self._lock:
+            self.last_status = st
+
+    def snapshot(self) -> dict:
+        """One consistent read of the routing state (status-console row)."""
+        with self._lock:
+            st = self.last_status or {}
+            return {"replica": self.name,
+                    "generation": self.generation,
+                    "in_flight": self.in_flight,
+                    "down": self.down,
+                    "draining": self.draining,
+                    "queue_depth": st.get("queue_depth", 0),
+                    "capacity": st.get("capacity", 0)}
 
     def call(self, op: str, *args):
         """One wire call on a pooled connection. A ``PSClientError`` is a
@@ -145,7 +205,7 @@ class Router:
         n = n_replicas if n_replicas is not None \
             else int(const.ENV.AUTODIST_SERVE_REPLICAS.val)
         self._factory = replica_factory
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._replicas: List[Replica] = []
         if addresses:
             self._replicas += [Replica(address=a) for a in addresses]
@@ -162,7 +222,7 @@ class Router:
         self._m_routed = reg.counter("serve.router.routed")
         self._m_shed = reg.counter("serve.router.shed")
         self._m_replayed = reg.counter("serve.router.replayed")
-        self._stop = threading.Event()
+        self._stop = san_event()
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(target=self._supervise,
@@ -178,13 +238,14 @@ class Router:
 
     def _pick(self, tried: List[Replica]) -> Optional[Replica]:
         """Least-loaded live replica not yet tried for this request; ties
-        break by fleet order (deterministic)."""
-        with self._lock:
-            cands = [r for r in self._replicas
-                     if not r.down and not r.draining and r not in tried]
+        break by fleet order (deterministic). Advisory: state may move
+        between the locked reads and the route, and the shed/replay cascade
+        absorbs that."""
+        cands = [r for r in self.replicas()
+                 if r not in tried and r.routable()]
         if not cands:
             return None
-        return min(cands, key=lambda r: r.in_flight)
+        return min(cands, key=lambda r: r.load())
 
     def generate(self, prompt, max_new_tokens: int, seed: int = 0,
                  timeout: Optional[float] = None,
@@ -247,10 +308,8 @@ class Router:
     def _on_replica_failure(self, rep: Replica):
         """Mark ``rep`` down exactly once, book the eviction, respawn a
         replacement through the budgeted policy."""
-        with self._lock:
-            if rep.down:
-                return
-            rep.down = True
+        if not rep.mark_down():
+            return
         logging.warning("router: replica %s is down; routing around it",
                         rep.name)
         _recovery.log_eviction(rep.name, kind="dead")
@@ -289,13 +348,11 @@ class Router:
         """``serve_p99_burn`` fired on ``rep``: drain it (no new routes;
         in-flight completes) and spawn a fresh replica on the SAME respawn
         budget — fault recovery promoted to autoscaling."""
-        if rep.draining:
+        if not rep.begin_drain():
             return
-        rep.draining = True
         logging.warning("router: replica %s draining (%s active)",
                         rep.name, DRAIN_ALERT)
-        with self._lock:
-            n_live = sum(not r.down for r in self._replicas)
+        n_live = sum(not r.is_down() for r in self.replicas())
         if self._factory is None or n_live >= self.max_replicas:
             return
         delay = self._policy.grant(f"scaleout:{rep.name}")
@@ -318,20 +375,19 @@ class Router:
         poll is a death (evict + respawn), an active ``serve_p99_burn``
         drains the replica + scales out, a cleared alert rejoins it."""
         for rep in self.replicas():
-            if rep.down:
+            if rep.is_down():
                 continue
             try:
                 st = rep.call("status")[0]
             except Exception:
                 self._on_replica_failure(rep)
                 continue
-            rep.last_status = st
+            rep.note_status(st)
             active = {a.get("rule")
                       for a in (st.get("alerts") or {}).get("active", [])}
             if DRAIN_ALERT in active:
                 self._scale_out(rep)
-            elif rep.draining:
-                rep.draining = False
+            elif rep.end_drain():
                 _recovery.log_rejoin(rep.name, rep.generation)
                 logging.info("router: replica %s rejoined (alert cleared)",
                              rep.name)
@@ -346,17 +402,7 @@ class Router:
     # ---------------------------------------------------------------- status
 
     def fleet_snapshot(self) -> List[dict]:
-        out = []
-        for rep in self.replicas():
-            st = rep.last_status or {}
-            out.append({"replica": rep.name,
-                        "generation": rep.generation,
-                        "in_flight": rep.in_flight,
-                        "down": rep.down,
-                        "draining": rep.draining,
-                        "queue_depth": st.get("queue_depth", 0),
-                        "capacity": st.get("capacity", 0)})
-        return out
+        return [rep.snapshot() for rep in self.replicas()]
 
     def close(self):
         self._stop.set()

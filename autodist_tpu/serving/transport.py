@@ -42,6 +42,7 @@ from autodist_tpu.parallel.ps_transport import (_PSClient, _RecvBuffer,
 from autodist_tpu.serving.batcher import ServeBusy, ServeError
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.metrics import WireCounters
+from autodist_tpu.testing.sanitizer import san_lock
 
 # Hard ceiling on one request's server-side completion wait: a vanished
 # batcher must not park a handler thread forever (GL005's rule at the trust
@@ -146,7 +147,7 @@ class InferenceServer:
         # router that re-sends an in-flight request after a replica death
         # can never double-generate on a replica that already finished it.
         self._dedup: "OrderedDict[str, tuple]" = OrderedDict()
-        self._dedup_lock = threading.Lock()
+        self._dedup_lock = san_lock()
         self._conns: set = set()
         self._server = _wire_server(host, port, self)
         self._thread = threading.Thread(target=self._server.serve_forever,

@@ -52,6 +52,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from autodist_tpu import const
 from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["AlertRule", "AlertEngine", "AlertHalt", "AlertRecover",
            "DEFAULT_RULES", "load_rules", "set_engine", "get_engine",
@@ -369,7 +370,7 @@ class AlertEngine:
             raise ValueError(f"unknown alert action {self.action!r}; "
                              f"valid: {', '.join(ACTIONS)}")
         self._recorder = recorder   # None -> resolved per policy at fire time
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._state: Dict[str, _RuleState] = {r.name: _RuleState()
                                               for r in self.rules}
         self._resolved: List[Dict[str, Any]] = []
@@ -490,7 +491,7 @@ class AlertEngine:
 # ------------------------------------------------------------ process global
 
 _ENGINE: Optional[AlertEngine] = None
-_ENGINE_LOCK = threading.Lock()
+_ENGINE_LOCK = san_lock()
 
 
 def set_engine(engine: Optional[AlertEngine]):

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from autodist_tpu import const
 from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock, san_event
 
 __all__ = ["MetricsHistory", "set_history", "get_history", "get_or_create",
            "maybe_sample", "load_history_jsonl"]
@@ -100,7 +101,7 @@ class MetricsHistory:
             engine = _alerts.get_or_create()
         self.engine = engine or None    # engine=False -> no alerting
         self._samples: collections.deque = collections.deque(maxlen=self.ring)
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._last_sample = -float("inf")
         proc = int(const.ENV.AUTODIST_PROCESS_ID.val)
         self._shard_tag = f"w{proc}-p{os.getpid()}.jsonl"
@@ -108,7 +109,7 @@ class MetricsHistory:
         self._shard_path: Optional[str] = None
         self._shard_count = 0
         self._warned_write = False
-        self._stop = threading.Event()
+        self._stop = san_event()
         self._thread: Optional[threading.Thread] = None
 
     # ---------------------------------------------------------------- sampling
@@ -297,7 +298,7 @@ class MetricsHistory:
 # ------------------------------------------------------------ process global
 
 _HISTORY: Optional[MetricsHistory] = None
-_HISTORY_LOCK = threading.Lock()
+_HISTORY_LOCK = san_lock()
 # Tri-state env-arming cache: None = not yet checked, False = checked and
 # unarmed (maybe_sample stays a two-read no-op), True = armed.
 _ENV_ARMED: Optional[bool] = None

@@ -19,6 +19,7 @@ import collections
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry",
            "counter", "gauge", "histogram", "snapshot", "event", "events",
@@ -73,7 +74,7 @@ class Counter:
     def __init__(self, name: str):
         self.name = name
         self._value: Number = 0
-        self._lock = threading.Lock()
+        self._lock = san_lock()
 
     def inc(self, n: Number = 1):
         with self._lock:
@@ -95,7 +96,7 @@ class Gauge:
     def __init__(self, name: str):
         self.name = name
         self._value: Number = 0
-        self._lock = threading.Lock()
+        self._lock = san_lock()
 
     def set(self, value: Number):
         with self._lock:
@@ -131,7 +132,7 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1: the +inf bucket
         self._sum: float = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = san_lock()
 
     def observe(self, value: Number):
         idx = bisect.bisect_left(self.buckets, value)
@@ -235,7 +236,7 @@ class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
         self._events = collections.deque(maxlen=_EVENT_RING)
-        self._lock = threading.Lock()
+        self._lock = san_lock()
 
     def _get(self, name: str, cls, *args):
         with self._lock:

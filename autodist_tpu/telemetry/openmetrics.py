@@ -37,6 +37,7 @@ from typing import Dict, Optional, Tuple
 from autodist_tpu import const
 from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["render", "metric_name", "MetricsExporter", "maybe_serve",
            "get_exporter", "set_exporter", "CONTENT_TYPE"]
@@ -173,7 +174,7 @@ class MetricsExporter:
 
 
 _EXPORTER: Optional[MetricsExporter] = None
-_EXPORTER_LOCK = threading.Lock()
+_EXPORTER_LOCK = san_lock()
 
 
 def set_exporter(exporter: Optional[MetricsExporter]):

@@ -45,6 +45,7 @@ from autodist_tpu import const
 from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.telemetry import spans as _spans
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["PeakSpec", "peak_spec", "ProgramCost", "enable", "disable",
            "active", "reset", "note_dispatch", "record_program_cost",
@@ -200,7 +201,7 @@ class _State:
 
     def __init__(self):
         self.enabled = False
-        self.lock = threading.Lock()
+        self.lock = san_lock()
         self.costs: Dict[str, ProgramCost] = {}
         self.analytic_flops_per_step: Optional[float] = None
         self.periods: List[Dict[str, Any]] = []

@@ -38,6 +38,7 @@ from autodist_tpu import const
 from autodist_tpu.telemetry import cluster as _cluster
 from autodist_tpu.telemetry import metrics as _metrics
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["FlightRecorder", "set_recorder", "get_recorder", "get_or_create",
            "maybe_record", "build_manifest"]
@@ -144,7 +145,7 @@ class FlightRecorder:
         self.min_interval_s = float(const.ENV.AUTODIST_RECORDER_MIN_S.val
                                     if min_interval_s is None
                                     else min_interval_s)
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._last_record = -float("inf")
         self._seq = self._next_seq()
 
@@ -269,7 +270,7 @@ class FlightRecorder:
 
 
 _RECORDER: Optional[FlightRecorder] = None
-_RECORDER_LOCK = threading.Lock()
+_RECORDER_LOCK = san_lock()
 
 
 def set_recorder(recorder: Optional[FlightRecorder]):

@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, Optional
 
 from autodist_tpu import const
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["span", "traced", "enable", "disable", "enabled", "clear",
            "snapshot_spans"]
@@ -63,7 +64,7 @@ class _State:
         self.ring_dur = collections.deque(maxlen=capacity)
         self.ring_args = collections.deque(maxlen=capacity)
         self.thread_names: Dict[int, str] = {}
-        self.lock = threading.Lock()
+        self.lock = san_lock()
         # Export offsets span timestamps against this epoch so traces start
         # near t=0 instead of at an arbitrary monotonic-clock origin.
         self.epoch_ns = time.perf_counter_ns()

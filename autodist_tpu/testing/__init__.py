@@ -6,8 +6,15 @@ provides deterministic, env/arg-keyed fault points (worker crash at step N,
 worker hang, NaN-in-grads, wire connect refusal) that the product code
 consults at a handful of instrumented sites. Un-armed, every site costs one
 module-global read.
+
+:mod:`sanitizer` is the same philosophy for the threaded plane (graftsan):
+env-armed wrappers around ``threading`` primitives that detect lock-order
+cycles, unbounded waits and leaked threads at runtime, and export observed
+lock-order edges for ``graftlint --crosscheck``. Un-armed, its factories
+return bare primitives — one module-global check at creation time.
 """
 
+from autodist_tpu.testing import sanitizer  # before faults: faults uses it
 from autodist_tpu.testing import faults
 
-__all__ = ["faults"]
+__all__ = ["faults", "sanitizer"]

@@ -56,6 +56,7 @@ import time
 from typing import Any, Dict, List, Optional, Union
 
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 __all__ = ["FaultPoint", "WorkerCrashed", "KINDS", "parse", "install",
            "clear", "armed", "should_fire", "hang_s", "corrupt_batch",
@@ -138,7 +139,7 @@ def parse(spec: str) -> List[FaultPoint]:
     return out
 
 
-_LOCK = threading.Lock()
+_LOCK = san_lock()
 _PLAN: Optional[List[FaultPoint]] = None
 _ENV_CHECKED = False
 

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Union
 
 from autodist_tpu import telemetry
 from autodist_tpu.utils import logging
+from autodist_tpu.testing.sanitizer import san_lock
 
 _WIRE_TEL = None
 
@@ -64,7 +65,7 @@ class WireCounters:
         self.msgs_received = 0
         self.encode_s = 0.0
         self.decode_s = 0.0
-        self._lock = threading.Lock()
+        self._lock = san_lock()
         self._mirror = mirror
 
     def add_sent(self, nbytes: int, encode_s: float = 0.0):

@@ -18,6 +18,7 @@ import math
 import time
 
 import numpy as np
+from autodist_tpu.testing.sanitizer import san_lock
 
 BASELINE_TOKENS_PER_SEC_PER_DEVICE = 20_000.0
 
@@ -1005,7 +1006,7 @@ def serve_bench(requests: int = 32, clients: int = 8, max_batch: int = 4):
             engine, dataclasses.replace(scfg, mode=mode))
         server = serving.InferenceServer(batcher)
         timings, errors = [], []
-        lock = threading.Lock()
+        lock = san_lock()
 
         def client_thread(wid):
             c = serving.ServeClient(server.address)
@@ -1184,7 +1185,7 @@ def serve_fleet_bench(requests: int = 24, fleet_requests: int = 16,
 
     def offered_load(router_server, n, max_new):
         ok, errors = [], []
-        lock = threading.Lock()
+        lock = san_lock()
 
         def client_thread(wid):
             c = serving.ServeClient(router_server.address)
@@ -1253,14 +1254,18 @@ def serve_fleet_bench(requests: int = 24, fleet_requests: int = 16,
 
             def killer():
                 deadline = time.monotonic() + 10.0
-                while victim.in_flight == 0 and time.monotonic() < deadline:
+                while victim.load() == 0 and time.monotonic() < deadline:
                     time.sleep(0.001)
                 victim.server.kill()
 
-            kt = threading.Thread(target=killer)
+            kt = threading.Thread(target=killer, name="bench-fleet-killer")
             kt.start()
-            ok, errors, _ = offered_load(server, fleet_requests, 24)
-            kt.join()
+            try:
+                ok, errors, _ = offered_load(server, fleet_requests, 24)
+            finally:
+                # join unconditionally: a failed load leg used to leak the
+                # non-daemon killer past the bench (thread-fence finding)
+                kt.join(timeout=15.0)
             counts = _recovery.recovery_snapshot()["counts"]
             if errors or len(ok) != fleet_requests:
                 raise RuntimeError(
@@ -1776,12 +1781,18 @@ def selfheal_bench(steps_per_worker: int = 60, crash_at: int = 25,
 
         try:
             t0 = time.perf_counter()
-            threads = [threading.Thread(target=drive, args=(wid,))
+            threads = [threading.Thread(target=drive, args=(wid,),
+                                        name=f"bench-selfheal-{wid}")
                        for wid in range(n_workers)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            try:
+                for t in threads:
+                    t.start()
+            finally:
+                # join in a finally: a start() failure or interrupt must
+                # not leak the already-running non-daemon drive threads
+                for t in threads:
+                    if t.is_alive():
+                        t.join()
             dt = time.perf_counter() - t0
             total = runner.service.updates_applied
             post_rate = None

@@ -35,7 +35,7 @@ if [[ "${1:-}" == "--dist" ]]; then
     exit 0
 fi
 
-echo "=== [1/4] lint ==="
+echo "=== [1/5] lint ==="
 # Prefer a real linter when the environment has one; otherwise fall back to a
 # full-tree syntax check (this image ships no ruff/flake8).
 if python -m ruff --version >/dev/null 2>&1; then
@@ -86,7 +86,42 @@ print(f"graftlint OK: {d['files_checked']} files in {d['wall_time_s']}s, "
       f"{warm}")
 EOF
 
-echo "=== [2/4] test suite (8-device CPU-sim mesh) ==="
+echo "=== [2/5] runtime sanitizer (graftsan) + crosscheck ==="
+# Two cheap suites run with the concurrency sanitizer fully armed: the data
+# plane's prefetch/loader threading and the fleet router units (the FakeEngine
+# ones — no LM build). A dynamic ABBA, an untimed wait, or a leaked non-daemon
+# thread raises in-test; the artifact's meta line double-checks zero recorded
+# violations. ~20s total (docs/usage/static_analysis.md#runtime-sanitizer-graftsan).
+rm -f .graftlint_cache/observed_locks.jsonl
+AUTODIST_SANITIZE=locks,waits,threads JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_data_plane.py \
+    tests/test_serve_fleet.py::test_router_routes_and_spreads \
+    tests/test_serve_fleet.py::test_router_sheds_typed_busy_when_all_replicas_full \
+    tests/test_serve_fleet.py::test_kill_a_replica_completes_all_requests_zero_failures \
+    tests/test_serve_fleet.py::test_rid_dedup_replay_is_idempotent \
+    tests/test_serve_fleet.py::test_router_drains_and_scales_out_on_alert \
+    tests/test_serve_fleet.py::test_fault_hook_kills_replica_deterministically \
+    tests/test_serve_fleet.py::test_respawn_policy_budget_and_booking \
+    tests/test_serve_fleet.py::test_fleet_flags_registered \
+    tests/test_serve_fleet.py::test_router_status_renders_in_consoles
+python - <<'EOF'
+import json
+path = ".graftlint_cache/observed_locks.jsonl"
+lines = [json.loads(l) for l in open(path, encoding="utf-8")]
+assert lines, f"{path}: sanitizer exported nothing"
+metas = [l["meta"] for l in lines if "meta" in l]
+assert metas, f"{path}: no meta header"
+bad = sum(m["violations"] for m in metas)
+assert bad == 0, f"sanitizer recorded {bad} violation(s) — see the armed run"
+print(f"graftsan OK: {sum(m['edges'] for m in metas)} observed lock-order "
+      f"edge(s), {metas[-1]['locks_tracked']} lock site(s), 0 violations")
+EOF
+# The observed edges feed straight back into the static analyzer: a cycle in
+# the merged runtime digraph or an edge opposite a static nesting fails here;
+# never-observed static edges print as informational "unexercised" coverage.
+python tools/graftlint.py --crosscheck
+
+echo "=== [3/5] test suite (8-device CPU-sim mesh) ==="
 # Sharded across 4 pytest processes (tools/parallel_tests.py): the slow tail
 # is multi-process-cluster latency, not CPU, so sharding overlaps those waits
 # with the compile-heavy files (41:31 -> 35:00 on this image's single core;
@@ -104,11 +139,11 @@ if [[ "$FAST" == "1" ]]; then
     exit 0
 fi
 
-echo "=== [3/4] multi-chip dryrun (virtual 8-device mesh + real 2- and 4-process legs) ==="
+echo "=== [4/5] multi-chip dryrun (virtual 8-device mesh + real 2- and 4-process legs) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "=== [4/4] bench smoke ==="
+echo "=== [5/5] bench smoke ==="
 # ZeRO weight-update sharding gate FIRST: it must run in a fresh process so
 # it can simulate a dp=2 CPU mesh before the backend initializes; gates the
 # per-device opt-state byte ratio against the zero_update row.

@@ -27,6 +27,7 @@ import numpy as np
 
 from autodist_tpu import serving
 from autodist_tpu.models import transformer_lm
+from autodist_tpu.testing.sanitizer import san_lock
 
 
 def percentile(xs, q):
@@ -86,7 +87,7 @@ def main(argv=None):
     finally:
         warm.close()
     timings, errors = [], []
-    lock = threading.Lock()
+    lock = san_lock()
 
     def client_thread(worker_id):
         c = serving.ServeClient(server.address)

@@ -39,3 +39,17 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "integration" in item.keywords and item.get_closest_marker("integration"):
             item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _graftsan_thread_fence():
+    """graftsan ``threads`` fence: with ``AUTODIST_SANITIZE=threads`` armed,
+    a test leaking a live non-daemon thread past its own teardown fails with
+    every survivor's name and current stack (testing/sanitizer.py). Disarmed
+    (the default), the fixture is a no-op yield."""
+    from autodist_tpu.testing import sanitizer
+    if "threads" not in sanitizer.modes():
+        yield
+        return
+    with sanitizer.thread_fence(grace_s=2.0):
+        yield

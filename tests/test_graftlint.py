@@ -1469,13 +1469,13 @@ def test_cli_explain_documents_real_bug_provenance(capsys):
     assert cli.main(["--explain", "GL999"]) == 2
 
 
-def test_all_eleven_checks_are_registered():
+def test_all_twelve_checks_are_registered():
     checks = core.all_checks()
-    assert set(checks) == {f"GL{i:03d}" for i in range(1, 12)}
+    assert set(checks) == {f"GL{i:03d}" for i in range(1, 13)}
     # Interprocedural + registry checks run at program scope; the registry
     # checks additionally need the COMPLETE path set to be sound.
     assert {c for c, v in checks.items() if v.program} \
-        == {"GL001", "GL002", "GL009", "GL010", "GL011"}
+        == {"GL001", "GL002", "GL009", "GL010", "GL011", "GL012"}
     assert {c for c, v in checks.items() if v.full_program} \
         == {"GL009", "GL011"}
 
@@ -1698,6 +1698,319 @@ def test_json_output_reports_wall_time_and_cache(tmp_path, capsys):
                    "--cache-dir", str(tmp_path / "c"), str(good)])
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0 and payload["cache"]["enabled"] is True
+
+
+# --------------------------------------------------------------------- GL012
+
+# The Batcher._held shape: a guard inferred from one method's locked write,
+# a bare write in the scheduling loop a Thread entry reaches.
+GL012_MIXED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            with self._lock:
+                self._count += 1
+
+        def read(self):
+            return self._count
+"""
+
+
+def test_gl012_flags_mixed_guarded_bare_attr(tmp_path):
+    res = lint(tmp_path, GL012_MIXED, checks=["GL012"])
+    assert codes(res) == ["GL012"]
+    (f,) = res.findings
+    assert "Worker._count" in f.message and "_lock" in f.message
+    assert f.scope == "Worker.read"
+
+
+def test_gl012_thread_entry_reachability(tmp_path):
+    # The bare write sits TWO self-calls below the Thread target: the
+    # finding needs the intra-family reachability walk, not entry matching.
+    res = lint(tmp_path, """
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = 0
+
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                self._pending -= 1
+
+            def submit(self):
+                with self._lock:
+                    self._pending += 1
+    """, checks=["GL012"])
+    assert codes(res) == ["GL012"]
+    (f,) = res.findings
+    assert "Pipe._pending" in f.message
+    assert f.scope == "Pipe._step"
+
+
+def test_gl012_suppression_with_reason_honored(tmp_path):
+    res = lint(tmp_path, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._count += 1
+
+            def read(self):
+                # graftlint: disable=GL012(monotonic progress gauge; one-round staleness is harmless)
+                return self._count
+    """, checks=["GL012"])
+    assert codes(res) == []
+    assert [r for _, r in res.suppressed] \
+        == ["monotonic progress gauge; one-round staleness is harmless"]
+
+
+def test_gl012_locked_helper_and_all_guarded_clean(tmp_path):
+    # A method only ever CALLED under the guard is credited with it
+    # (_inflight_locked idiom), and a fully-guarded class has no finding.
+    res = lint(tmp_path, """
+        import threading
+
+        class Clean:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._items += 1
+
+            def _drain_locked(self):
+                n = self._items
+                self._items = 0
+                return n
+
+            def close(self):
+                with self._lock:
+                    return self._drain_locked()
+    """, checks=["GL012"])
+    assert codes(res) == []
+
+
+def test_gl012_cross_class_typed_receiver(tmp_path):
+    # Replica.in_flight shape: the guard and the bare read both live in
+    # ANOTHER class, reaching the attr through an annotated parameter —
+    # shared-object concurrency, no Thread() in sight.
+    res = lint(tmp_path, """
+        import threading
+
+        class Rep:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.busy = 0
+
+        class Rt:
+            def hit(self, rep: Rep):
+                with rep._lock:
+                    rep.busy += 1
+
+            def peek(self, rep: Rep):
+                return rep.busy
+    """, checks=["GL012"])
+    assert codes(res) == ["GL012"]
+    (f,) = res.findings
+    assert "Rep.busy" in f.message
+    assert f.scope == "Rt.peek"
+
+
+def test_gl012_inherited_entry_and_base_call_site(tmp_path):
+    # The _BatcherBase shape: the Thread entry AND the guarded call site
+    # live on the base class; the override's bare sibling access in the
+    # subclass's own loop path must still be found.
+    res = lint(tmp_path, """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._held = None
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                self._admit()
+
+            def _admit(self):
+                raise NotImplementedError
+
+            def close(self):
+                with self._lock:
+                    self._drain_locked()
+
+            def _drain_locked(self):
+                raise NotImplementedError
+
+        class Impl(Base):
+            def _drain_locked(self):
+                held, self._held = self._held, None
+                return held
+
+            def _admit(self):
+                self._held = object()
+    """, checks=["GL012"])
+    assert codes(res) == ["GL012"]
+    (f,) = res.findings
+    assert "._held" in f.message
+    assert f.scope == "Impl._admit"
+
+
+def test_gl012_ambiguous_guard_and_init_writes_skipped(tmp_path):
+    # Two different locks guard writes -> discipline is ambiguous, skip;
+    # __init__ self-writes are construction, never "bare" sites.
+    res = lint(tmp_path, """
+        import threading
+
+        class TwoGuards:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._a_lock:
+                    self._n += 1
+
+            def other(self):
+                with self._b_lock:
+                    self._n += 1
+
+            def read(self):
+                return self._n
+    """, checks=["GL012"])
+    assert codes(res) == []
+
+
+# ----------------------------------------------------------------- crosscheck
+
+def _crosscheck_program(tmp_path, files):
+    for relname, source in files.items():
+        path = tmp_path / relname
+        path.write_text(textwrap.dedent(source))
+    mods = {rel: core.Module(str(tmp_path / rel), rel,
+                             textwrap.dedent(src))
+            for rel, src in files.items()}
+    from autodist_tpu.analysis.program import ProgramIndex
+    return ProgramIndex(mods)
+
+
+CROSSCHECK_ORDERED = """
+    import threading
+
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+
+    def both():
+        with _a_lock:
+            with _b_lock:
+                pass
+"""
+
+
+def _obs(outer, inner, count=1):
+    return {"outer": {"path": outer[0], "name": outer[1], "cls": None},
+            "inner": {"path": inner[0], "name": inner[1], "cls": None},
+            "count": count}
+
+
+def test_crosscheck_dynamic_only_cycle_is_a_finding(tmp_path):
+    from autodist_tpu.analysis.checks import concurrency
+    prog = _crosscheck_program(tmp_path, {"mod.py": "x = 1\n"})
+    observed = [_obs(("x.py", "A"), ("y.py", "B")),
+                _obs(("y.py", "B"), ("x.py", "A"))]
+    findings, unexercised = concurrency.crosscheck(prog, observed)
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+    assert "x.py:A" in findings[0].message and "y.py:B" in findings[0].message
+    assert unexercised == []
+
+
+def test_crosscheck_observed_reverse_of_static_edge(tmp_path):
+    from autodist_tpu.analysis.checks import concurrency
+    prog = _crosscheck_program(tmp_path, {"mod.py": CROSSCHECK_ORDERED})
+    observed = [_obs(("mod.py", "_b_lock"), ("mod.py", "_a_lock"))]
+    findings, unexercised = concurrency.crosscheck(prog, observed)
+    assert len(findings) == 1
+    assert "opposite" in findings[0].message
+    assert findings[0].path == "mod.py"
+    # the static a->b edge itself was never exercised forward
+    assert len(unexercised) == 1
+    assert unexercised[0]["outer"]["name"] == "_a_lock"
+
+
+def test_crosscheck_exercised_edge_is_clean(tmp_path):
+    from autodist_tpu.analysis.checks import concurrency
+    prog = _crosscheck_program(tmp_path, {"mod.py": CROSSCHECK_ORDERED})
+    observed = [_obs(("mod.py", "_a_lock"), ("mod.py", "_b_lock"), count=7)]
+    findings, unexercised = concurrency.crosscheck(prog, observed)
+    assert findings == []
+    assert unexercised == []
+
+
+def test_crosscheck_cli_consumes_sanitizer_artifact(tmp_path, capsys):
+    # End-to-end over a REAL module: the staleness service's declared
+    # _write_mutex -> _lock order, contradicted by a hand-built observed
+    # file (meta header line included — the loader must skip it).
+    obs = tmp_path / "observed.jsonl"
+    rel = "autodist_tpu/parallel/staleness.py"
+    obs.write_text(
+        json.dumps({"meta": {"modes": ["locks"]}}) + "\n"
+        + json.dumps(_obs((rel, "self._lock"), (rel, "self._write_mutex")))
+        + "\n")
+    rc = cli.main(["--crosscheck", "--observed", str(obs), rel])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "opposite of the static nesting" in out
+
+    # meta-only artifact: nothing observed, static edges all unexercised,
+    # still exit 0 (informational).
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"meta": {"modes": ["locks"]}}) + "\n")
+    rc = cli.main(["--crosscheck", "--observed", str(empty), rel])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "unexercised" in out
+
+    # a missing artifact is a usage error, not a silent green
+    rc = cli.main(["--crosscheck", "--observed",
+                   str(tmp_path / "nope.jsonl"), rel])
+    assert rc == 2
 
 
 # ------------------------------------------------------------ self-cleanness

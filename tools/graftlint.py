@@ -9,6 +9,9 @@ Usage:
     python tools/graftlint.py --list-checks
     python tools/graftlint.py --changed-only       # pre-commit: git-changed files
     python tools/graftlint.py --write-baseline ... # re-grandfather findings
+    python tools/graftlint.py --crosscheck ...     # merge sanitizer-observed
+                                                   # lock edges into GL002's
+                                                   # static graph
 
 Default paths mirror the CI gate: autodist_tpu tests examples bench.py.
 Exit status: 0 = clean (only suppressed/baselined findings), 1 = new
@@ -114,6 +117,82 @@ def to_sarif(result, checks) -> dict:
     }
 
 
+def run_crosscheck(paths, observed_path: str, fmt: str) -> int:
+    """``--crosscheck``: merge the sanitizer's observed lock-order edges
+    (``testing/sanitizer.py`` export) into GL002's static identity graph.
+
+    A dedicated tool path, NOT part of ``lint_paths``: its input is a
+    run-dependent artifact, so its results must never enter the lint result
+    cache (the warm-cache CI assertion stays meaningful) or the baseline.
+    Exit 1 on dynamic-only findings; unexercised static edges are
+    informational (exit 0)."""
+    from autodist_tpu.analysis.checks import concurrency
+    from autodist_tpu.analysis.program import ProgramIndex
+
+    records = []
+    try:
+        with open(observed_path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "outer" in rec and "inner" in rec:
+                    records.append(rec)
+    except OSError as e:
+        print(f"graftlint: --crosscheck cannot read observed edges "
+              f"({e}); run a sanitizer-armed suite "
+              f"(AUTODIST_SANITIZE=locks) first", file=sys.stderr)
+        return 2
+
+    modules = {}
+    try:
+        for path in core.iter_py_files(paths, ROOT):
+            rel = os.path.relpath(path, ROOT)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    source = fh.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            mod = core.Module(path, rel, source)
+            if mod.parse_error is None:
+                modules[rel] = mod
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    program = ProgramIndex(modules)
+    findings, unexercised = concurrency.crosscheck(program, records)
+
+    if fmt == "json":
+        print(json.dumps({
+            "version": 1,
+            "observed_edges": len(records),
+            "modules": len(modules),
+            "findings": [f.to_json() for f in findings],
+            "unexercised": unexercised,
+            "ok": not findings,
+        }, indent=1))
+        return 0 if not findings else 1
+
+    for f in findings:
+        print(f.render())
+    for u in unexercised:
+        print(f"graftlint: crosscheck: static edge "
+              f"{u['outer']['path']}:{u['outer']['name']} -> "
+              f"{u['inner']['path']}:{u['inner']['name']} "
+              f"(established at {u['path']}:{u['line']}) was never observed "
+              f"at runtime — the lock model has coverage the run didn't "
+              f"earn")
+    print(f"graftlint --crosscheck: {len(findings)} dynamic finding(s), "
+          f"{len(unexercised)} unexercised static edge(s), "
+          f"{len(records)} observed edge(s) over {len(modules)} module(s)")
+    return 0 if not findings else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftlint", description=__doc__,
@@ -138,6 +217,17 @@ def main(argv=None) -> int:
                     help="disable the on-disk result cache")
     ap.add_argument("--cache-dir", default=DEFAULT_CACHE_DIR,
                     help="result cache directory (default: .graftlint_cache)")
+    ap.add_argument("--crosscheck", action="store_true",
+                    help="merge sanitizer-observed lock-order edges "
+                         "(--observed) into GL002's static graph: "
+                         "dynamic-only cycles and order contradictions "
+                         "fail; unexercised static edges are reported "
+                         "informationally")
+    ap.add_argument("--observed",
+                    default=os.path.join(DEFAULT_CACHE_DIR,
+                                         "observed_locks.jsonl"),
+                    help="observed-edges JSONL exported by a "
+                         "sanitizer-armed run (AUTODIST_SANITIZE=locks)")
     ap.add_argument("--changed-only", action="store_true",
                     help="lint only git-changed files (pre-commit mode). "
                          "Whole-program registry checks (GL009/GL011) are "
@@ -166,6 +256,12 @@ def main(argv=None) -> int:
         if unknown:
             print(f"unknown check(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
+    if args.crosscheck:
+        if args.format == "sarif":
+            print("--crosscheck supports text/json output", file=sys.stderr)
+            return 2
+        return run_crosscheck(args.paths or DEFAULT_PATHS, args.observed,
+                              args.format)
 
     skip_full_program = False
     partial_paths = False

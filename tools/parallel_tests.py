@@ -22,9 +22,14 @@ import glob
 import os
 import subprocess
 import sys
+import threading
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from autodist_tpu.testing.sanitizer import san_lock  # noqa: E402
 
 
 def shard_files(n: int):
@@ -83,17 +88,47 @@ def main(argv=None):
         print(f"shard {i}: {len(shard)} files "
               f"({', '.join(os.path.basename(f) for f in shard[:3])}...)")
 
-    failed = False
-    for i, (p, log) in enumerate(zip(procs, logs)):
+    # One waiter thread per shard (sanitizer-factory lock around the shared
+    # result map) so a finished shard reports immediately instead of behind
+    # a slower earlier one. The finally is the teardown discipline the
+    # thread-leak fence flagged: an interrupt used to abandon the remaining
+    # shard PROCESSES and the waiters parked on them — now the children are
+    # terminated and every waiter joined before main exits.
+    results = {}
+    results_lock = san_lock()
+
+    def wait_one(i, p, log):
         rc = p.wait()
         log.close()
         with open(log.name) as f:
             tail = f.read().strip().splitlines()
+        with results_lock:
+            results[i] = (rc, tail, log.name)
         summary = tail[-1] if tail else "(no output)"
         print(f"shard {i}: rc={rc}  {summary}")
+
+    waiters = [threading.Thread(target=wait_one, args=(i, p, log),
+                                name=f"shard-waiter-{i}")
+               for i, (p, log) in enumerate(zip(procs, logs))]
+    try:
+        for t in waiters:
+            t.start()
+        for t in waiters:
+            t.join()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for t in waiters:
+            if t.is_alive():
+                t.join(timeout=30.0)
+
+    failed = False
+    for i in sorted(results):
+        rc, tail, log_name = results[i]
         if rc != 0:
             failed = True
-            print(f"--- shard {i} failures (see {log.name}) ---")
+            print(f"--- shard {i} failures (see {log_name}) ---")
             print("\n".join(line for line in tail if "FAILED" in line
                             or "ERROR" in line) or "\n".join(tail[-15:]))
     print(f"total wall clock: {time.time() - t0:.0f}s across "
