@@ -178,6 +178,13 @@ KNOWN_FLAGS = {
                          "(unbounded/lock-holding waits), 'threads' "
                          "(non-daemon thread-leak fence); empty = disarmed "
                          "(san_lock() returns bare primitives)",
+    "AUTODIST_REQTRACE": "request-trace plane: per-process ring of serving "
+                         "request lifecycle records (received/queued/"
+                         "admitted/prefill/decode/shed/replayed/finished) "
+                         "keyed by rid, pullable fleet-wide via the "
+                         "`reqtrace` opcode (tools/adtrace.py)",
+    "AUTODIST_REQTRACE_RING": "request-trace ring capacity (lifecycle "
+                              "records retained per process)",
     "AUTODIST_WIRE_DTYPE": "quantized PS gradient push: 'fp16', 'bf16' or "
                            "'int8' compresses eligible gradient leaves on "
                            "the wire (error feedback keeps convergence); "
@@ -361,6 +368,13 @@ _ENV_DEFAULTS = {
     # factories return bare threading primitives — hot-path cost is one
     # module-global check at CREATION time, zero per acquire.
     "AUTODIST_SANITIZE": "",
+    # Request-trace plane (autodist_tpu/telemetry/reqtrace.py): bounded
+    # per-process ring of serving request lifecycle records keyed by rid.
+    # Off by default — the disarmed cost on every mark site is one module
+    # attribute read (the spans.py contract, gated by
+    # bench.py --reqtrace-overhead).
+    "AUTODIST_REQTRACE": False,
+    "AUTODIST_REQTRACE_RING": 4096,
     # Wire-compression plane (parallel/synchronization.WirePushCompressor):
     # quantized gradient pushes with error feedback plus sparse top-k pushes
     # for row-sparse params. WIRE_DTYPE empty = exact pushes (the tuned
@@ -438,6 +452,8 @@ class ENV(enum.Enum):
     AUTODIST_WIRE_BACKOFF_S = "AUTODIST_WIRE_BACKOFF_S"
     AUTODIST_FAULTS = "AUTODIST_FAULTS"
     AUTODIST_SANITIZE = "AUTODIST_SANITIZE"
+    AUTODIST_REQTRACE = "AUTODIST_REQTRACE"
+    AUTODIST_REQTRACE_RING = "AUTODIST_REQTRACE_RING"
     AUTODIST_WIRE_DTYPE = "AUTODIST_WIRE_DTYPE"
     AUTODIST_COMPRESS_MIN_BYTES = "AUTODIST_COMPRESS_MIN_BYTES"
     AUTODIST_SPARSE_PUSH = "AUTODIST_SPARSE_PUSH"

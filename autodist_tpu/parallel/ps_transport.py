@@ -947,8 +947,9 @@ class PSClientError(RuntimeError):
 # IDEMPOTENT: repeating the request after a transport failure cannot change
 # server state a second time, so the client may transparently reconnect and
 # retry (AUTODIST_WIRE_RETRIES budget, jittered exponential backoff):
-#   read / read_if_newer / read_min / version / stats / status / trace —
-#     pure reads; ping — stateless echo; push_trace — latest-ring-wins sink;
+#   read / read_if_newer / read_min / version / stats / status / trace /
+#     reqtrace — pure reads; ping — stateless echo; push_trace —
+#     latest-ring-wins sink;
 #   register — idempotent ONLY with an explicit worker_id (a live slot keeps
 #     its count); register(None) ALLOCATES a fresh slot per request, so a
 #     replay would leave a phantom live slot pinning min(steps) forever —
@@ -962,7 +963,8 @@ class PSClientError(RuntimeError):
 #   (advances the step count), record (writes a snapshot dir per request).
 IDEMPOTENT_OPS = frozenset({
     "read", "read_if_newer", "read_min", "version", "stats", "status",
-    "ping", "trace", "push_trace", "register", "start_step", "wire_caps"})
+    "ping", "trace", "reqtrace", "push_trace", "register", "start_step",
+    "wire_caps"})
 
 
 def _retry_safe(msg) -> bool:

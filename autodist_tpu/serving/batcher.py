@@ -34,6 +34,7 @@ PR 5 cluster trace when telemetry is enabled.
 """
 
 import dataclasses
+import inspect
 import itertools
 import threading
 import time
@@ -47,7 +48,13 @@ from autodist_tpu import telemetry
 # the prefetch producers and the serving batchers. data.prefetch stays
 # jax-free at import, preserving this module's jax-free contract.
 from autodist_tpu.data.prefetch import EMPTY, BoundedQueue, QueueClosed
+from autodist_tpu.telemetry import reqtrace as _reqtrace
 from autodist_tpu.testing.sanitizer import san_lock, san_event
+
+# Request-phase attribution vocabulary (the serving twin of
+# profiling.ATTR_PHASES): per-round share gauges serve.attr.<phase>, shares
+# summing to 1.0 over the completions the round observed.
+ATTR_PHASES = ("wire", "queue", "prefill", "decode")
 
 
 class ServeError(RuntimeError):
@@ -161,14 +168,22 @@ class ServeRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "seed", "keys",
                  "t_submit", "t_admit", "t_prefill_done", "t_done",
                  "done", "tokens", "output", "error", "slot",
-                 "abandoned", "deadline")
+                 "abandoned", "deadline", "rid_token", "wire_s")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, rid_token: Optional[str] = None,
+                 wire_s: float = 0.0):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.seed = seed
+        # Fleet-scope identity: the router's rid token (when one rode the
+        # wire) is what joins this request's records across processes; the
+        # local rid stays the slot-table/dedup key. wire_s is the
+        # trace-context decomposition the transport computed at receive
+        # (origin send stamp + per-connection clock offset).
+        self.rid_token = rid_token
+        self.wire_s = wire_s
         self.keys = None                  # per-step sampling keys [max_new, 2]
         self.t_submit = time.perf_counter()
         self.t_admit = 0.0
@@ -181,6 +196,12 @@ class ServeRequest:
         self.slot = -1
         self.abandoned = False            # client gave up; drop, don't decode
         self.deadline = 0.0               # t_submit + request_timeout_s
+
+    @property
+    def trace_key(self):
+        """The request-trace join key: the fleet rid token when one exists,
+        else the local rid (direct clients trace per process only)."""
+        return self.rid_token if self.rid_token is not None else self.rid
 
     def abandon(self):
         """Mark the request not worth finishing (its client stopped
@@ -233,13 +254,45 @@ class _ServeMetrics:
         self.submitted = reg.counter("serve.requests.submitted")
         self.completed = reg.counter("serve.requests.completed")
         self.rejected = reg.counter("serve.requests.rejected")
+        # Per-phase attribution (the serving twin of train.attr.*): shares
+        # of completed requests' wall time summing to 1.0, recomputed each
+        # scheduler round from the completions since the last flush.
+        self.attr = {p: reg.gauge(f"serve.attr.{p}") for p in ATTR_PHASES}
+        self._attr_acc = {p: 0.0 for p in ATTR_PHASES}
+        self._attr_lock = san_lock()
 
     def observe(self, req: ServeRequest):
         t = req.timing()
         self.lat["queue"].observe(t["queue_s"])
         self.lat["prefill"].observe(t["prefill_s"])
         self.lat["decode"].observe(t["decode_s"])
-        self.lat["total"].observe(t["total_s"])
+        # The total histogram carries the slowest-in-window EXEMPLAR: rid +
+        # phase breakdown, so a firing serve_p99_burn names a concrete
+        # traceable request instead of a quantile.
+        self.lat["total"].observe(t["total_s"], exemplar={
+            "rid": str(req.trace_key), "wire_s": round(req.wire_s, 6),
+            "queue_s": t["queue_s"], "prefill_s": t["prefill_s"],
+            "decode_s": t["decode_s"], "total_s": t["total_s"]})
+        with self._attr_lock:
+            self._attr_acc["wire"] += max(0.0, req.wire_s)
+            self._attr_acc["queue"] += max(0.0, t["queue_s"])
+            self._attr_acc["prefill"] += max(0.0, t["prefill_s"])
+            self._attr_acc["decode"] += max(0.0, t["decode_s"])
+
+    def flush_attr(self):
+        """Fold the completions observed since the last flush into the
+        serve.attr.* share gauges (called once per scheduler round; a round
+        with no completions keeps the previous shares — gauges that flap to
+        zero between requests would be unreadable on a console)."""
+        with self._attr_lock:
+            parts = dict(self._attr_acc)
+            total = sum(parts.values())
+            if total <= 0.0:
+                return
+            for p in ATTR_PHASES:
+                self._attr_acc[p] = 0.0
+        for p in ATTR_PHASES:
+            self.attr[p].set(round(parts[p] / total, 4))
 
 
 class _BatcherBase:
@@ -292,11 +345,13 @@ class _BatcherBase:
             raise ServeError("server is shutting down") from None
         if not admitted:
             self._metrics.rejected.inc()
+            _reqtrace.mark(req.trace_key, "shed", reason="queue_full")
             raise ServeBusy(
                 f"serving queue is full ({self.config.max_queue} "
                 f"waiting); retry later")
         self._metrics.submitted.inc()
         self._metrics.depth.set(len(self._waiting))
+        _reqtrace.mark(req.trace_key, "queued", depth=len(self._waiting))
         return req
 
     def queue_depth(self) -> int:
@@ -346,6 +401,10 @@ class _BatcherBase:
                 _logging.warning("serving: %s (AUTODIST_ALERT_ACTION=halt "
                                  "does not stop the scheduler loop; drain "
                                  "via the router instead)", e)
+            # Per-round phase attribution: fold the completions this round
+            # observed into the serve.attr.* share gauges (no-op when no
+            # request completed since the last round).
+            self._metrics.flush_attr()
             if not self.run_once() and not self._stop.is_set():
                 # Bounded idle poll on the staging queue (wakes instantly
                 # on an admission, at IDLE_WAIT_S otherwise).
@@ -358,6 +417,8 @@ class _BatcherBase:
         req.finish(error="request abandoned by its client" if req.abandoned
                    else "request timed out (request_timeout_s passed)")
         self._metrics.rejected.inc()
+        _reqtrace.mark(req.trace_key, "shed",
+                       reason="abandoned" if req.abandoned else "deadline")
 
     def run_once(self) -> bool:
         raise NotImplementedError
@@ -390,20 +451,31 @@ class Batcher(_BatcherBase):
         # _inflight_locked — if join(30) times out the scheduler thread is
         # still live, so the bare-access version raced.
         self._held: Optional[ServeRequest] = None
+        # Paged engines accept the trace rid on can_admit (they mark the
+        # admission wait behind the page budget); plain engines/fakes keep
+        # the two-argument form. Resolved once, not per admission round.
+        ca = getattr(engine, "can_admit", None)
+        self._can_admit_rid = (ca is not None and
+                               "rid" in inspect.signature(ca).parameters)
         if start:
             self._start()
 
     # ------------------------------------------------------------- admission
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int,
-               seed: int = 0) -> ServeRequest:
+               seed: int = 0, rid_token: Optional[str] = None,
+               wire_s: float = 0.0) -> ServeRequest:
         """Validate + enqueue; returns the request whose ``done`` event the
         caller waits on. Raises :class:`ServeError` on an invalid request or
         a full queue. The sampling-key schedule is built at ADMISSION, not
-        here — a rejected request must cost no device work."""
+        here — a rejected request must cost no device work. ``rid_token`` /
+        ``wire_s`` are the transport's trace context: the fleet-scope rid
+        and the decomposed wire seconds (see :class:`ServeRequest`)."""
         prompt = self._validate(prompt, max_new_tokens)
         return self._enqueue(ServeRequest(next(self._rid), prompt,
-                                          max_new_tokens, seed=seed))
+                                          max_new_tokens, seed=seed,
+                                          rid_token=rid_token,
+                                          wire_s=wire_s))
 
     def _validate(self, prompt, max_new_tokens: int) -> np.ndarray:
         if not isinstance(prompt, np.ndarray) or prompt.ndim != 1 \
@@ -536,9 +608,10 @@ class Batcher(_BatcherBase):
         batch: List[Tuple[int, ServeRequest]] = []
         while free:
             if held is not None:
-                req, held = held, None
+                req, held, fresh = held, None, False
             else:
                 req = self._waiting.pop_nowait()
+                fresh = True
                 if req is EMPTY:
                     break
             if req.dead(now):
@@ -546,7 +619,15 @@ class Batcher(_BatcherBase):
                 continue
             if can_admit is not None:
                 try:
-                    ok = can_admit(int(req.prompt.size), req.max_new_tokens)
+                    # The trace rid rides only the FIRST check: a held-back
+                    # request is re-checked every round, and one admit_wait
+                    # mark per wait (not per 20ms retry) is the record.
+                    if self._can_admit_rid and fresh:
+                        ok = can_admit(int(req.prompt.size),
+                                       req.max_new_tokens, rid=req.trace_key)
+                    else:
+                        ok = can_admit(int(req.prompt.size),
+                                       req.max_new_tokens)
                 except ServeError as e:
                     req.finish(error=str(e))
                     self._metrics.rejected.inc()
@@ -566,9 +647,12 @@ class Batcher(_BatcherBase):
         for slot, req in batch:
             req.t_admit = time.perf_counter()
             req.slot = slot
+            _reqtrace.mark(req.trace_key, "admitted", slot=slot)
             # Key schedule built here, not in submit(): only admitted
             # requests may cost device work.
             req.keys = self._engine.make_keys(req.seed, req.max_new_tokens)
+            _reqtrace.mark(req.trace_key, "prefill_start",
+                           prompt_len=int(req.prompt.size))
             try:
                 with telemetry.span("serve.prefill", slot=slot, rid=req.rid,
                                     prompt_len=int(req.prompt.size)):
@@ -582,6 +666,8 @@ class Batcher(_BatcherBase):
                 self._metrics.rejected.inc()
                 continue
             req.t_prefill_done = time.perf_counter()
+            _reqtrace.mark(req.trace_key, "prefill_end")
+            _reqtrace.mark(req.trace_key, "first_token")
             req.tokens.append(int(first))
             if len(req.tokens) >= req.max_new_tokens \
                     or int(first) == self.config.eos_id:
@@ -600,6 +686,7 @@ class Batcher(_BatcherBase):
         KV-cache slot for the next waiter."""
         self._release(slot)
         req.stamp_done()
+        _reqtrace.mark(req.trace_key, "done", tokens=len(req.tokens))
         self._metrics.completed.inc()
         self._metrics.observe(req)
         req.done.set()

@@ -59,6 +59,7 @@ import numpy as np
 from autodist_tpu import telemetry
 from autodist_tpu.serving.batcher import (ServeConfig, ServeError, bucket_for,
                                           default_buckets, pad_prompt)
+from autodist_tpu.telemetry import reqtrace as _reqtrace
 
 
 def page_buckets(max_pages: int) -> Tuple[int, ...]:
@@ -380,13 +381,16 @@ class PagedLMEngine:
             for page in self._prefix.pop_lru() or []:
                 self._alloc.release(page)
 
-    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  rid=None) -> bool:
         """The batcher's admission gate: True RESERVES the request's whole
         page budget (consumed by the matching ``admit``, FIFO); False = not
         yet (the batcher holds the request back); a request that can NEVER
         fit raises ``ServeError`` (rejected, not head-of-line-blocked). The
         budget ignores possible prefix sharing — conservative, so a lazy
-        draw can never fail; ``admit`` returns the savings."""
+        draw can never fail; ``admit`` returns the savings. ``rid`` is the
+        request's trace key; when the gate holds the request back, an
+        ``admit_wait`` mark records the page shortfall against it."""
         total = self._pages_total(prompt_len, max_new_tokens)
         if total > self._alloc.usable:
             raise ServeError(
@@ -395,6 +399,9 @@ class PagedLMEngine:
         if not self._alloc.can_reserve(total):
             self._evict_for(total)
         if not self._alloc.can_reserve(total):
+            if rid is not None:
+                _reqtrace.mark(rid, "admit_wait", pages_needed=total,
+                               pages_free=self._alloc.free_count())
             return False
         self._alloc.reserve(total)
         self._pending.append((prompt_len, max_new_tokens, total))

@@ -39,6 +39,8 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from autodist_tpu import telemetry
+from autodist_tpu.telemetry import cluster as _cluster
+from autodist_tpu.telemetry import reqtrace as _reqtrace
 from autodist_tpu.coordinator import RespawnPolicy
 from autodist_tpu.parallel import recovery as _recovery
 from autodist_tpu.parallel.ps_transport import _PSClient, PSClientError
@@ -81,6 +83,7 @@ class Replica:
         self.last_status: dict = {}
         self._lock = san_lock()
         self._idle: List[_PSClient] = []
+        self._offset_ns: Optional[int] = None
 
     # ------------------------------------------------- routing-state access
 
@@ -136,6 +139,31 @@ class Replica:
                     "draining": self.draining,
                     "queue_depth": st.get("queue_depth", 0),
                     "capacity": st.get("capacity", 0)}
+
+    def clock_offset_ns(self) -> int:
+        """Replica-minus-router wall-clock offset, NTP-estimated from three
+        ``ping`` round-trips (:func:`telemetry.ntp_offset`) and cached for
+        the replica's lifetime — the router stamps it into each forwarded
+        trace token so the replica can subtract its OWN clock from the
+        router's send stamp (wire-vs-queue decomposition). An unreachable
+        replica estimates 0 (the route itself will fail and replay); the
+        loopback test fleets share one clock, so 0 is also exact there."""
+        with self._lock:
+            if self._offset_ns is not None:
+                return self._offset_ns
+        samples = []
+        try:
+            for _ in range(3):
+                t0 = time.time_ns()
+                _, s_ns = self.call("ping", t0)
+                samples.append((t0, int(s_ns), time.time_ns()))
+            off, _err = _cluster.ntp_offset(samples)
+        except Exception:
+            off = 0
+        with self._lock:
+            if self._offset_ns is None:
+                self._offset_ns = int(off)
+            return self._offset_ns
 
     def call(self, op: str, *args):
         """One wire call on a pooled connection. A ``PSClientError`` is a
@@ -236,6 +264,12 @@ class Router:
         with self._lock:
             return list(self._replicas)
 
+    def next_rid(self) -> str:
+        """A fresh fleet-scope rid. ``RouterServer`` stamps one onto each
+        request whose client sent no token, so every request through the
+        front door is dedup-safe and traceable."""
+        return f"router-{next(self._rseq)}"
+
     def _pick(self, tried: List[Replica]) -> Optional[Replica]:
         """Least-loaded live replica not yet tried for this request; ties
         break by fleet order (deterministic). Advisory: state may move
@@ -257,12 +291,14 @@ class Router:
         prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
         seq = next(self._rseq)
         rid = rid if rid is not None else f"router-{seq}"
+        _reqtrace.mark(rid, "received", hop=0)
         tried: List[Replica] = []
         replays = 0
         while True:
             rep = self._pick(tried)
             if rep is None:
                 self._m_shed.inc()
+                _reqtrace.mark(rid, "shed", reason="fleet_busy")
                 raise ServeBusy("all replicas are at capacity or "
                                 "unavailable; retry later")
             tried.append(rep)
@@ -275,10 +311,23 @@ class Router:
                 rep.server.kill()
             with rep._lock:
                 rep.in_flight += 1
+            # Trace context rides the wire only when the request plane is
+            # armed: ``(rid, send_wall_ns, hop, offset_ns)`` trailing the
+            # plain 5-tuple. hop counts replays, so a replayed request
+            # renders as ONE trace with a visible failover; offset_ns is
+            # this replica's clock minus ours, so the replica can split
+            # wire time out of its queue time.
+            if _reqtrace.enabled():
+                send_wall = time.time_ns()
+                _reqtrace.mark(rid, "sent", replica=rep.name, hop=replays,
+                               send_wall_ns=send_wall)
+                extra = (rid, send_wall, replays, rep.clock_offset_ns())
+            else:
+                extra = (rid,)
             try:
                 tokens, timing = rep.call(
                     "generate", prompt, int(max_new_tokens), int(seed),
-                    timeout, rid)
+                    timeout, *extra)
             except PSClientError as e:
                 if str(e).startswith("ServeBusy:"):
                     continue          # shed cascade: next replica
@@ -291,6 +340,8 @@ class Router:
                 self._on_replica_failure(rep)
                 self._m_replayed.inc()
                 replays += 1
+                _reqtrace.mark(rid, "replayed", replica=rep.name,
+                               hop=replays)
                 if replays >= MAX_REPLAYS:
                     raise ServeError(
                         f"request {rid} lost {replays} replicas; fleet "
@@ -301,6 +352,7 @@ class Router:
                 with rep._lock:
                     rep.in_flight -= 1
             self._m_routed.inc()
+            _reqtrace.mark(rid, "finished", replica=rep.name)
             return np.asarray(tokens), timing
 
     # ------------------------------------------------- failure + autoscaling
@@ -475,9 +527,13 @@ class RouterServer:
             if op == "generate":
                 # Same arity contract as the replica arm, trailing rid
                 # included — a client-supplied dedup token is honored
-                # end to end.
+                # end to end; absent one, the router mints the fleet-scope
+                # rid HERE so the transport span carries it (span-ring and
+                # reqtrace records join on this id).
                 _, prompt, max_new, seed, timeout, *rest = msg
-                rid = str(rest[0]) if rest else None
+                rid = str(rest[0]) if rest else self._router.next_rid()
+                if sp is not None:
+                    sp.set(rid=rid)
                 tokens, timing = self._router.generate(
                     prompt, int(max_new), seed=int(seed), timeout=timeout,
                     rid=rid)
@@ -486,6 +542,17 @@ class RouterServer:
                 return ("ok", self.status_snapshot())
             if op == "status":
                 return ("ok", self.status_snapshot())
+            if op == "trace":
+                # Span-ring pull: the router process's lane in the merged
+                # fleet timeline (tools/adtrace.py).
+                since = msg[1] if len(msg) > 1 else None
+                return ("ok", telemetry.local_trace_state(since_ns=since))
+            if op == "reqtrace":
+                # Request-lifecycle pull: the router-side marks (received/
+                # sent/replayed/shed/finished) for the fleet merge.
+                since = msg[1] if len(msg) > 1 else None
+                return ("ok",
+                        telemetry.local_reqtrace_state(since_ns=since))
             if op == "ping":
                 return ("ok", msg[1] if len(msg) > 1 else None,
                         time.time_ns())

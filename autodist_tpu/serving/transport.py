@@ -35,6 +35,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from autodist_tpu import telemetry
+from autodist_tpu.telemetry import reqtrace as _reqtrace
 from autodist_tpu.parallel import wire
 from autodist_tpu.parallel.ps_transport import (_PSClient, _RecvBuffer,
                                                 _recv_msg, _send_payload,
@@ -233,19 +234,42 @@ class InferenceServer:
                 if self._batcher.kind != "lm":
                     raise ServeError("this server hosts a stateless apply "
                                      "batcher; use the 'infer' op")
-                # Optional trailing element: the router's replay-dedup
-                # token. Plain clients send the 5-tuple; arity stays
-                # backward compatible either way.
+                # Optional trailing elements: the router's replay-dedup
+                # token, optionally extended into the full trace context
+                # ``(rid, send_wall_ns, hop, offset_ns)`` when the request
+                # plane is armed. Plain clients send the 5-tuple; arity
+                # stays backward compatible either way.
                 _, prompt, max_new, seed, timeout, *rest = msg
                 rid_token = str(rest[0]) if rest else None
+                wire_s = 0.0
+                if len(rest) >= 4 and _reqtrace.enabled():
+                    # Wire-vs-queue decomposition: the router stamped its
+                    # send wall-ns and its estimate of OUR clock minus its
+                    # own (ntp_offset over ping round-trips), so
+                    # now - send - offset is time spent on the wire, not
+                    # in our queue. Clamped: a noisy offset estimate must
+                    # never produce negative wire time.
+                    send_wall, hop, offset = (int(rest[1]), int(rest[2]),
+                                              int(rest[3]))
+                    wire_ns = max(0, time.time_ns() - send_wall - offset)
+                    wire_s = wire_ns / 1e9
+                    _reqtrace.mark(rid_token, "received", hop=hop,
+                                   wire_ns=wire_ns)
                 if rid_token is not None:
                     with self._dedup_lock:
                         cached = self._dedup.get(rid_token)
                     if cached is not None:
                         return cached
-                req = self._batcher.submit(prompt, max_new, seed=int(seed))
+                req = self._batcher.submit(prompt, max_new, seed=int(seed),
+                                           rid_token=rid_token,
+                                           wire_s=wire_s)
                 if sp is not None:
+                    # Both ids ride the span: the local rid joins the
+                    # prefill/decode spans, the fleet-scope token joins
+                    # the router's records and the reqtrace plane.
                     sp.set(rid=req.rid)
+                    if rid_token is not None:
+                        sp.set(rid_token=rid_token)
                 reply = self._wait(req, timeout)
                 if rid_token is not None and reply[0] == "ok":
                     with self._dedup_lock:
@@ -268,6 +292,18 @@ class InferenceServer:
                 # Live-ops console plane (tools/adtop.py): stats plus the
                 # in-flight request table.
                 return ("ok", self.status_snapshot())
+            if op == "trace":
+                # Span-ring pull (same columnar blob as the PS wire's arm)
+                # so tools/adtrace.py merges replica spans into the fleet
+                # timeline without a PS transport up.
+                since = msg[1] if len(msg) > 1 else None
+                return ("ok", telemetry.local_trace_state(since_ns=since))
+            if op == "reqtrace":
+                # Request-lifecycle pull: this process's reqtrace ring as
+                # a columnar blob (rebased + merged by telemetry.cluster).
+                since = msg[1] if len(msg) > 1 else None
+                return ("ok",
+                        telemetry.local_reqtrace_state(since_ns=since))
             if op == "ping":
                 return ("ok", msg[1] if len(msg) > 1 else None,
                         time.time_ns())

@@ -59,16 +59,20 @@ costs one attribute check per span (gated in ``bench.py
 per train step (``bench.py --health-overhead`` gates the enabled side).
 """
 
-from autodist_tpu.telemetry import alerts, history, openmetrics
+from autodist_tpu.telemetry import alerts, history, openmetrics, reqtrace
 from autodist_tpu.telemetry.alerts import (AlertEngine, AlertHalt,
                                            AlertRecover, AlertRule)
 from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
                                             dump_events_jsonl,
+                                            dump_reqtrace_jsonl,
                                             dump_spans_jsonl,
                                             load_events_jsonl,
+                                            load_reqtrace_jsonl,
                                             load_trace_jsonl,
+                                            local_reqtrace_state,
                                             local_trace_state,
-                                            merge_trace_states, ntp_offset)
+                                            merge_trace_states, ntp_offset,
+                                            reqtrace_marks)
 from autodist_tpu.telemetry.export import (chrome_trace_events, emit_metrics,
                                            export_chrome_trace,
                                            opt_state_bytes,
@@ -101,6 +105,8 @@ __all__ = [
     "collect_cluster_trace", "local_trace_state", "merge_trace_states",
     "dump_spans_jsonl", "load_trace_jsonl", "ntp_offset",
     "dump_events_jsonl", "load_events_jsonl",
+    "reqtrace", "local_reqtrace_state", "reqtrace_marks",
+    "dump_reqtrace_jsonl", "load_reqtrace_jsonl",
     "HealthConfig", "HealthHalt", "HealthMonitor", "HealthRecover",
     "FlightRecorder", "set_recorder", "get_recorder", "maybe_record",
     "build_manifest",

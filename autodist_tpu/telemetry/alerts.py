@@ -399,6 +399,16 @@ class AlertEngine:
                                     "(%s); treating as not firing",
                                     rule.name, e)
                 detail = None
+            if detail is not None and rule.kind == "burn_rate":
+                # A burn-rate firing names a concrete traceable request: the
+                # latency histogram's slowest-in-window exemplar (rid + phase
+                # breakdown) rides into the alert record — and from there
+                # into status snapshots and the flight-recorder manifest.
+                inst = _metrics.registry().get(rule.metric)
+                ex = (inst.exemplar()
+                      if isinstance(inst, _metrics.Histogram) else None)
+                if ex is not None:
+                    detail = dict(detail, exemplar=ex)
             with self._lock:
                 st = self._state.setdefault(rule.name, _RuleState())
                 if detail is not None and not st.active:

@@ -41,16 +41,23 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from autodist_tpu.telemetry import reqtrace as _reqtrace
 from autodist_tpu.telemetry import spans as _spans
 from autodist_tpu.utils import logging
 
 __all__ = ["local_trace_state", "ntp_offset", "trace_state_events",
            "merge_trace_states", "collect_cluster_trace", "dump_spans_jsonl",
-           "load_trace_jsonl", "dump_events_jsonl", "load_events_jsonl"]
+           "load_trace_jsonl", "dump_events_jsonl", "load_events_jsonl",
+           "local_reqtrace_state", "reqtrace_marks", "reqtrace_trace_events",
+           "dump_reqtrace_jsonl", "load_reqtrace_jsonl"]
 
 # Trace-blob schema version (bumped on layout changes so an old tracedump
 # rejects a new dump instead of misreading it).
 TRACE_STATE_VERSION = 1
+
+# Request-trace blob schema version (the `reqtrace` opcode's payload and the
+# offline reqtrace JSONL dumps both carry it).
+REQTRACE_STATE_VERSION = 1
 
 _PLAIN = frozenset((str, int, float, bool, type(None)))
 
@@ -193,9 +200,17 @@ def trace_state_events(state: Dict[str, Any], pid: int,
 
 def _assign_pid(state: Dict[str, Any], used: set) -> int:
     """Deterministic lane id: chief -> 0, worker w -> w + 1, collisions walk
-    to the next free id (two blobs from the same worker id stay distinct)."""
+    to the next free id (two blobs from the same worker id stay distinct).
+    Non-numeric worker labels (adtrace tags blobs with their ``host:port``
+    endpoint) start from the next free slot after the numeric lanes."""
     wid = state.get("worker_id")
-    pid = 0 if wid is None else int(wid) + 1
+    if wid is None:
+        pid = 0
+    else:
+        try:
+            pid = int(wid) + 1
+        except (TypeError, ValueError):
+            pid = len(used) + 1
     while pid in used:
         pid += 1
     used.add(pid)
@@ -231,22 +246,38 @@ def instant_trace_events(records: Iterable[Dict[str, Any]], pid: int,
 
 
 def merge_trace_states(states: Iterable[Dict[str, Any]], path: str,
-                       instant_events: Iterable[Dict[str, Any]] = ()) -> str:
+                       instant_events: Iterable[Dict[str, Any]] = (),
+                       reqtrace_states: Iterable[Dict[str, Any]] = ()) -> str:
     """Merge trace blobs into ONE Chrome trace file at ``path``.
 
     Every blob's spans are rebased onto the chief wall clock
     (``wall + clock_offset_ns``); the merged origin is the earliest rebased
     span start across all lanes, so the file opens at t=0 in Perfetto.
     ``instant_events`` (registry event records — anomalies) overlay the
-    timeline as instant markers on their own lane. Returns ``path``."""
+    timeline as instant markers on their own lane. ``reqtrace_states``
+    (request-lifecycle blobs, :func:`local_reqtrace_state`) add per-request
+    lanes and flow arrows (router ``sent`` -> replica ``received``) on the
+    SAME clock; a reqtrace blob from a process that also contributed a span
+    blob (matched by host + OS pid) shares that process's lane. Returns
+    ``path``."""
     states = list(states)
+    reqtrace_states = list(reqtrace_states)
     for st in states:
         v = st.get("v", TRACE_STATE_VERSION)
         if v != TRACE_STATE_VERSION:
             raise ValueError(f"trace state version {v} is not supported "
                              f"(this build reads v{TRACE_STATE_VERSION})")
+    for st in reqtrace_states:
+        v = st.get("v", REQTRACE_STATE_VERSION)
+        if v != REQTRACE_STATE_VERSION:
+            raise ValueError(f"reqtrace state version {v} is not supported "
+                             f"(this build reads v{REQTRACE_STATE_VERSION})")
     origins = [int(_wall_starts(st).min()) for st in states
                if len(np.asarray(st["t0_ns"])) > 0]
+    for st in reqtrace_states:
+        marks = reqtrace_marks(st)
+        if marks:
+            origins.append(min(m["wall_ns"] for m in marks))
     instant_events = list(instant_events)
     if not origins and instant_events:
         # Every ring is empty (recording off — an armed recorder without
@@ -258,8 +289,19 @@ def merge_trace_states(states: Iterable[Dict[str, Any]], path: str,
     origin_ns = min(origins) if origins else 0
     events: List[Dict[str, Any]] = []
     used: set = set()
+    lane_by_proc: Dict[Tuple[Any, Any], int] = {}
     for st in states:
-        events.extend(trace_state_events(st, _assign_pid(st, used), origin_ns))
+        pid = _assign_pid(st, used)
+        lane_by_proc.setdefault((st.get("host"), st.get("pid")), pid)
+        events.extend(trace_state_events(st, pid, origin_ns))
+    for st in reqtrace_states:
+        key = (st.get("host"), st.get("pid"))
+        pid = lane_by_proc.get(key)
+        if pid is None:
+            pid = lane_by_proc[key] = _assign_pid(st, used)
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {"name": _lane_label(st)}})
+        events.extend(reqtrace_trace_events(st, pid, origin_ns))
     if instant_events:
         pid = max(used) + 1 if used else 0
         events.extend(instant_trace_events(instant_events, pid, origin_ns))
@@ -383,6 +425,204 @@ def load_trace_jsonl(path: str,
                  args_json=_args_json(args_map))
     state["thread_names"] = {int(t): nm for t, nm in
                              dict(meta.get("thread_names", {})).items()}
+    return state
+
+
+# --------------------------------------------------------- request traces
+
+# Named sub-intervals a request's marks imply, rendered as "X" slices on the
+# request's lane: (slice name, start phase, end phase). First occurrence of
+# the start phase, last of the end phase — a replayed request's repeated
+# marks widen the interval instead of fragmenting it.
+_REQ_INTERVALS = (
+    ("queue", "queued", "admitted"),
+    ("prefill", "prefill_start", "prefill_end"),
+    ("decode", "first_token", "done"),
+    ("route", "received", "finished"),
+)
+# Phases rendered as instant markers (discrete lifecycle facts, no duration).
+_REQ_INSTANTS = ("shed", "replayed")
+# Request lanes use tids far above any interned-span lane index but well
+# below real pthread idents, so a merged file never collides either way.
+_REQ_TID_BASE = 1_000_000
+
+
+def local_reqtrace_state(since_ns: Optional[int] = None,
+                         worker_id: Optional[int] = None,
+                         clock_offset_ns: int = 0) -> Dict[str, Any]:
+    """Snapshot this process's request-lifecycle ring
+    (:mod:`autodist_tpu.telemetry.reqtrace`) as a wire-encodable columnar
+    blob — the ``reqtrace`` opcode's payload, same shape discipline as
+    :func:`local_trace_state`: a de-duplicated phase table, an int32 phase
+    index column, int64 mark stamps, rids verbatim (they are the join key
+    and unbounded — interning them would leak), sparse mark args as one
+    JSON string, and the back-to-back ``(wall_ns, perf_ns)`` pair the merge
+    rebases with."""
+    (pid, epoch_ns, phases, rids, phase_idx, t_ns, args,
+     wall_ns, perf_ns) = _reqtrace._export_columns(since_ns)
+    return {
+        "v": REQTRACE_STATE_VERSION,
+        "pid": pid,
+        "host": socket.gethostname(),
+        "worker_id": worker_id,
+        "wall_ns": wall_ns,
+        "perf_ns": perf_ns,
+        "epoch_ns": epoch_ns,
+        "clock_offset_ns": int(clock_offset_ns),
+        "phases": phases,
+        "rids": [str(r) for r in rids],
+        "phase_idx": np.array(phase_idx, np.int32),
+        "t_ns": np.array(t_ns, np.int64),
+        "args_json": _args_json({i: _sanitize_args(a)
+                                 for i, a in enumerate(args) if a}),
+    }
+
+
+def reqtrace_marks(state: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One blob's marks rebased onto the merged timeline's wall clock:
+    ``{rid, phase, wall_ns, args}`` dicts, oldest first. ``wall_ns`` is the
+    blob's wall/perf pair applied to the mark stamp plus the blob's
+    ``clock_offset_ns`` — the exact :func:`_wall_starts` arithmetic, so
+    span slices and request marks from one process land on one clock."""
+    base = (int(state["wall_ns"]) - int(state["perf_ns"])
+            + int(state.get("clock_offset_ns", 0)))
+    phases = list(state["phases"])
+    args_map = _parse_args_json(state)
+    out: List[Dict[str, Any]] = []
+    rids = list(state["rids"])
+    phase_idx = np.asarray(state["phase_idx"], np.int64)
+    t_ns = np.asarray(state["t_ns"], np.int64)
+    for i in range(len(rids)):
+        out.append({"rid": rids[i], "phase": phases[phase_idx[i]],
+                    "wall_ns": int(t_ns[i]) + base,
+                    "args": args_map.get(i) or {}})
+    return out
+
+
+def reqtrace_trace_events(state: Dict[str, Any], pid: int,
+                          origin_ns: int) -> List[Dict[str, Any]]:
+    """One reqtrace blob as Chrome trace events on lane ``pid``: each rid
+    gets its own request lane (tid), its marks become "X" slices for the
+    :data:`_REQ_INTERVALS` its phases bound (a ``received`` mark carrying a
+    ``wire_ns`` arg additionally yields a ``wire`` slice ENDING at the
+    receive — the wire time the trace-context token decomposed), instant
+    markers for shed/replay, plus the FLOW halves: a ``"s"`` (flow start)
+    at every ``sent`` mark and a ``"f"`` (flow end) at every ``received``
+    mark, id ``<rid>/<hop>`` — the merge pairs a router's send arrow with
+    the replica's receive across lanes."""
+    by_rid = _reqtrace.group_records(reqtrace_marks(state))
+    events: List[Dict[str, Any]] = []
+    for lane, rid in enumerate(sorted(by_rid, key=str)):
+        tid = _REQ_TID_BASE + lane
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": f"req {rid}"}})
+        recs = by_rid[rid]
+        first = {}
+        last = {}
+        for phase, t, args in recs:
+            first.setdefault(phase, (t, args))
+            last[phase] = (t, args)
+        for name, p0, p1 in _REQ_INTERVALS:
+            if p0 in first and p1 in last:
+                t0, t1 = first[p0][0], last[p1][0]
+                if t1 >= t0:
+                    events.append({
+                        "name": name, "ph": "X", "cat": "reqtrace",
+                        "ts": float(t0 - origin_ns) / 1e3,
+                        "dur": float(t1 - t0) / 1e3,
+                        "pid": pid, "tid": tid, "args": {"rid": str(rid)}})
+        for phase, t, args in recs:
+            if phase == "received" and args.get("wire_ns"):
+                wire_ns = max(0, int(args["wire_ns"]))
+                events.append({
+                    "name": "wire", "ph": "X", "cat": "reqtrace",
+                    "ts": float(t - wire_ns - origin_ns) / 1e3,
+                    "dur": float(wire_ns) / 1e3,
+                    "pid": pid, "tid": tid, "args": {"rid": str(rid)}})
+                events.append({
+                    "name": "req", "ph": "f", "bp": "e", "cat": "reqtrace",
+                    "id": f"{rid}/{args.get('hop', 0)}",
+                    "ts": float(t - origin_ns) / 1e3,
+                    "pid": pid, "tid": tid})
+            elif phase == "sent":
+                events.append({
+                    "name": "req", "ph": "s", "cat": "reqtrace",
+                    "id": f"{rid}/{args.get('hop', 0)}",
+                    "ts": float(t - origin_ns) / 1e3,
+                    "pid": pid, "tid": tid})
+            elif phase in _REQ_INSTANTS:
+                events.append({
+                    "name": phase, "ph": "i", "s": "t", "cat": "reqtrace",
+                    "ts": float(t - origin_ns) / 1e3,
+                    "pid": pid, "tid": tid,
+                    "args": dict(_sanitize_args(args) or {}, rid=str(rid))})
+    return events
+
+
+def dump_reqtrace_jsonl(path: str, worker_id: Optional[int] = None,
+                        since_ns: Optional[int] = None,
+                        clock_offset_ns: int = 0) -> str:
+    """Dump this process's request-lifecycle ring as JSONL for offline
+    merging (the reqtrace twin of :func:`dump_spans_jsonl`): line 1 is the
+    blob metadata (``{"meta": {...}}``), every following line one mark
+    ``[rid, phase, t_ns, args]``."""
+    state = local_reqtrace_state(since_ns, worker_id=worker_id,
+                                 clock_offset_ns=clock_offset_ns)
+    meta = {k: state[k] for k in ("v", "pid", "host", "worker_id", "wall_ns",
+                                  "perf_ns", "epoch_ns", "clock_offset_ns")}
+    meta["kind"] = "reqtrace"
+    phases = state["phases"]
+    args_map = _parse_args_json(state)
+    with open(path, "w") as f:
+        f.write(json.dumps({"meta": meta}) + "\n")
+        for i in range(len(state["phase_idx"])):
+            f.write(json.dumps([state["rids"][i],
+                                phases[state["phase_idx"][i]],
+                                int(state["t_ns"][i]),
+                                args_map.get(i)]) + "\n")
+    return path
+
+
+def load_reqtrace_jsonl(path: str,
+                        clock_offset_ns: Optional[int] = None
+                        ) -> Dict[str, Any]:
+    """Load a :func:`dump_reqtrace_jsonl` file back into a reqtrace blob;
+    ``clock_offset_ns`` overrides the dumped offset (the ``tracedump
+    --offset`` hook)."""
+    with open(path) as f:
+        header = json.loads(f.readline())
+        if not isinstance(header, dict) or "meta" not in header \
+                or header["meta"].get("kind") != "reqtrace":
+            raise ValueError(f"{path}: not a reqtrace JSONL dump")
+        meta = dict(header["meta"])
+        if meta.get("v", REQTRACE_STATE_VERSION) != REQTRACE_STATE_VERSION:
+            raise ValueError(f"{path}: reqtrace dump version {meta.get('v')} "
+                             f"is not supported (this build reads "
+                             f"v{REQTRACE_STATE_VERSION})")
+        rows = [json.loads(line) for line in f if line.strip()]
+    meta.pop("kind", None)
+    phases: List[str] = []
+    phase_ix: Dict[str, int] = {}
+    n = len(rows)
+    phase_idx = np.empty(n, np.int32)
+    t_ns = np.empty(n, np.int64)
+    rids: List[str] = []
+    args_map: Dict[int, Dict[str, Any]] = {}
+    for i, (rid, phase, t, args) in enumerate(rows):
+        j = phase_ix.get(phase)
+        if j is None:
+            j = phase_ix[phase] = len(phases)
+            phases.append(phase)
+        phase_idx[i] = j
+        t_ns[i] = t
+        rids.append(str(rid))
+        if args:
+            args_map[i] = args
+    state = meta
+    if clock_offset_ns is not None:
+        state["clock_offset_ns"] = int(clock_offset_ns)
+    state.update(phases=phases, rids=rids, phase_idx=phase_idx, t_ns=t_ns,
+                 args_json=_args_json(args_map))
     return state
 
 

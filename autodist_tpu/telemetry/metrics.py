@@ -114,14 +114,26 @@ class Gauge:
         return self._value
 
 
+EXEMPLAR_WINDOW_S = 300.0
+
+
 class Histogram:
     """Fixed-bucket histogram: ``observe(v)`` lands in the first bucket whose
     upper bound satisfies ``v <= bound`` (Prometheus ``le`` semantics), with
     an implicit ``+inf`` overflow bucket. Bucket edges are fixed at
     construction — snapshots from different processes with the same edges
-    merge by element-wise addition."""
+    merge by element-wise addition.
 
-    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+    Observation sites may attach an EXEMPLAR — a small wire-encodable dict
+    identifying the concrete observation (a serving rid plus its phase
+    breakdown). The histogram keeps only the SLOWEST exemplar of the last
+    :data:`EXEMPLAR_WINDOW_S` seconds, so an alert firing on this histogram
+    can name one traceable request instead of an anonymous quantile.
+    Exemplars carry wall-clock time and live OUTSIDE :meth:`snapshot`
+    (which stays deterministic); read them via :meth:`exemplar`."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock",
+                 "_ex", "_ex_value", "_ex_t")
 
     def __init__(self, name: str, buckets: Sequence[Number] = SECONDS_BUCKETS):
         if not buckets or list(buckets) != sorted(buckets):
@@ -132,14 +144,33 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1: the +inf bucket
         self._sum: float = 0.0
         self._count = 0
+        self._ex: Optional[Dict[str, object]] = None
+        self._ex_value = 0.0
+        self._ex_t = 0.0
         self._lock = san_lock()
 
-    def observe(self, value: Number):
+    def observe(self, value: Number,
+                exemplar: Optional[Dict[str, object]] = None):
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                now = time.time()
+                if (value >= self._ex_value or self._ex is None
+                        or now - self._ex_t > EXEMPLAR_WINDOW_S):
+                    self._ex = dict(exemplar, value=float(value))
+                    self._ex_value = float(value)
+                    self._ex_t = now
+
+    def exemplar(self) -> Optional[Dict[str, object]]:
+        """The slowest exemplar observed within the last
+        :data:`EXEMPLAR_WINDOW_S` seconds (a copy), else None."""
+        with self._lock:
+            if self._ex is None or time.time() - self._ex_t > EXEMPLAR_WINDOW_S:
+                return None
+            return dict(self._ex)
 
     @property
     def count(self) -> int:
@@ -258,6 +289,13 @@ class Registry:
     def histogram(self, name: str,
                   buckets: Optional[Sequence[Number]] = None) -> Histogram:
         return self._get(name, Histogram, buckets or family_buckets(name))
+
+    def get(self, name: str) -> Optional[object]:
+        """The live instrument registered under ``name``, or None — a
+        NON-CREATING lookup for consumers (the alert engine's exemplar
+        attach) that must observe, never register."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def instruments(self) -> List[Tuple[str, object]]:
         """A point-in-time, name-sorted copy of the live instrument objects

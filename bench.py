@@ -831,6 +831,168 @@ def trace_pull_overhead(rounds: int = 5):
     return result
 
 
+def reqtrace_overhead(requests: int = 24, clients: int = 4):
+    """Request-trace plane cost bench (the serving analogue of
+    --telemetry-overhead):
+
+    - requests/s at a fixed offered load through a real 1-replica
+      Router + RouterServer fleet with the request-trace ring DISARMED
+      (production default) and ARMED (``AUTODIST_REQTRACE=1``: lifecycle
+      marks at every hop plus the wire trace token on each forwarded
+      generate),
+    - the disarmed ``reqtrace.mark`` direct cost in ns (1e5 calls — the
+      one-attribute-read contract) and the armed per-mark cost, and
+    - the implied ``armed_overhead_pct``: armed mark cost x the marks the
+      fleet actually booked per request (counted from the ring, so new
+      instrumentation sites raise the bill automatically) as a fraction of
+      the measured mean request latency. This is the gated number — the
+      ``reqtrace_overhead`` row in PERF_BASELINE.json carries
+      ``max_overhead_pct`` (2.0), and exceeding it means tracing a request
+      stopped being a handful of deque appends.
+
+    The rps pair is cross-checked against the recorded
+    ``armed_vs_disarmed_floor`` only as a wide backstop — closed-loop
+    loopback serving on a shared CPU box is noisy — so the
+    machine-relative direct-cost percentage is the hard gate."""
+    import sys
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu import serving
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.serving.router import Router, RouterServer
+    from autodist_tpu.telemetry import reqtrace
+
+    platform = jax.devices()[0].platform
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=2, n_layers=2, d_ff=256,
+        max_len=128, dtype=jnp.float32)
+    model, params = transformer_lm.init_params(cfg)
+
+    def replica_factory():
+        scfg = serving.ServeConfig(max_batch=4, temperature=0.0)
+        batcher = serving.Batcher(
+            serving.LMEngine(model, params, scfg), scfg)
+        return serving.InferenceServer(batcher)
+
+    def offered_load(router_server, n, max_new):
+        ok, errors = [], []
+        lock = san_lock()
+
+        def client_thread(wid):
+            c = serving.ServeClient(router_server.address)
+            try:
+                for i in range(wid, n, clients):
+                    try:
+                        prompt = np.arange(1, 9, dtype=np.int32) + i % 40
+                        tokens, _ = c.generate(prompt, max_new, seed=i)
+                        with lock:
+                            ok.append(tokens)
+                    except serving.ServeError as e:
+                        with lock:
+                            errors.append(str(e))
+            finally:
+                c.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client_thread, args=(w,))
+                   for w in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return ok, errors, time.perf_counter() - t0
+
+    was_armed = reqtrace.enabled()
+    reqtrace.disable()
+    walls = {}
+    router = Router(replica_factory, n_replicas=1, start=False)
+    server = RouterServer(router)
+    try:
+        for rep in router.replicas():      # compile off the clock
+            warm = serving.ServeClient(rep.address)
+            try:
+                warm.generate(np.arange(1, 9, dtype=np.int32), 2)
+            finally:
+                warm.close()
+        for mode in ("disarmed", "armed"):
+            if mode == "armed":
+                reqtrace.enable()
+                reqtrace.clear()
+            ok, errors, wall = offered_load(server, requests, 8)
+            if errors or len(ok) != requests:
+                raise RuntimeError(
+                    f"reqtrace bench ({mode}): {len(ok)}/{requests} ok, "
+                    f"errors: {errors[:3]}")
+            walls[mode] = wall
+        marks_per_request = len(reqtrace.snapshot_marks()) / requests
+    finally:
+        server.close()
+        reqtrace.clear()
+        reqtrace.disable()
+
+    # Direct per-mark costs, independent of loopback-serving noise: N marks
+    # each way, ns per call. The disarmed number IS the one-attribute-read
+    # contract; the armed number prices the intern lookup + deque appends.
+    n_marks = 100_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n_marks):
+        reqtrace.mark("bench", "queued")
+    disarmed_mark_ns = (time.perf_counter_ns() - t0) / n_marks
+    reqtrace.enable()
+    t0 = time.perf_counter_ns()
+    for _ in range(n_marks):
+        reqtrace.mark("bench", "queued")
+    armed_mark_ns = (time.perf_counter_ns() - t0) / n_marks
+    reqtrace.clear()
+    if not was_armed:
+        reqtrace.disable()
+
+    # clients closed-loop threads are busy for the whole wall, so total
+    # request-seconds ~= wall x clients and the mean latency follows.
+    request_ns = walls["armed"] * clients / requests * 1e9
+    armed_overhead_pct = 100.0 * armed_mark_ns * marks_per_request / request_ns
+
+    result = {
+        "metric": f"reqtrace_overhead ({platform}, 1-replica fleet, "
+                  f"{requests} req x {clients} clients)",
+        "unit": "req/s",
+        "rows": {"disarmed": round(requests / walls["disarmed"], 2),
+                 "armed": round(requests / walls["armed"], 2)},
+        "armed_vs_disarmed": round(walls["disarmed"] / walls["armed"], 4),
+        "disarmed_mark_ns": round(disarmed_mark_ns, 1),
+        "armed_mark_ns": round(armed_mark_ns, 1),
+        "marks_per_request": round(marks_per_request, 1),
+        "armed_overhead_pct": round(armed_overhead_pct, 4),
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("reqtrace_overhead")
+        if recorded:
+            max_pct = recorded.get("max_overhead_pct", 2.0)
+            if armed_overhead_pct > max_pct:
+                print(f"WARNING: armed request-trace overhead "
+                      f"{armed_overhead_pct:.3f}% of request latency exceeds "
+                      f"the {max_pct}% gate — a lifecycle mark stopped being "
+                      f"a handful of deque appends (see PERF_BASELINE.json "
+                      f"reqtrace_overhead)", file=sys.stderr)
+            floor = recorded.get("armed_vs_disarmed_floor")
+            if (floor and recorded.get("platform") == platform
+                    and result["armed_vs_disarmed"] < floor):
+                print(f"WARNING: armed-reqtrace req/s is "
+                      f"{result['armed_vs_disarmed']:.2f}x the disarmed "
+                      f"rate, below the recorded {floor:.2f}x floor — armed "
+                      f"recording got costlier on the serving path (see "
+                      f"PERF_BASELINE.json reqtrace_overhead)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    return result
+
+
 def zero_update_bench(steps: int = 60, dp: int = 2):
     """ZeRO weight-update sharding (arXiv 2004.13336) memory/step bench.
 
@@ -2057,6 +2219,14 @@ def main(argv=None):
              "and the loopback round-trip of one `trace` opcode pull, gated "
              "against max_stall_ms in the PERF_BASELINE.json trace_pull row")
     parser.add_argument(
+        "--reqtrace-overhead", action="store_true",
+        help="measure the request-trace plane's cost on a real 1-replica "
+             "router fleet: req/s with the lifecycle ring disarmed vs armed "
+             "(AUTODIST_REQTRACE=1) plus the direct per-mark costs, with "
+             "the armed mark cost x marks-per-request share of request "
+             "latency gated against max_overhead_pct in the "
+             "PERF_BASELINE.json reqtrace_overhead row")
+    parser.add_argument(
         "--zero", action="store_true",
         help="measure ZeRO weight-update sharding (AUTODIST_ZERO / zero=1) "
              "on the CPU micro-model at simulated dp>=2: per-device "
@@ -2136,6 +2306,9 @@ def main(argv=None):
         return
     if args.trace_pull_overhead:
         trace_pull_overhead()
+        return
+    if args.reqtrace_overhead:
+        reqtrace_overhead()
         return
     if args.zero:
         zero_update_bench()

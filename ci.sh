@@ -87,14 +87,16 @@ print(f"graftlint OK: {d['files_checked']} files in {d['wall_time_s']}s, "
 EOF
 
 echo "=== [2/5] runtime sanitizer (graftsan) + crosscheck ==="
-# Two cheap suites run with the concurrency sanitizer fully armed: the data
-# plane's prefetch/loader threading and the fleet router units (the FakeEngine
-# ones — no LM build). A dynamic ABBA, an untimed wait, or a leaked non-daemon
-# thread raises in-test; the artifact's meta line double-checks zero recorded
-# violations. ~20s total (docs/usage/static_analysis.md#runtime-sanitizer-graftsan).
+# Three cheap suites run with the concurrency sanitizer fully armed: the data
+# plane's prefetch/loader threading, the fleet router units, and the request-
+# trace plane (all FakeEngine — no LM build). A dynamic ABBA, an untimed wait,
+# or a leaked non-daemon thread raises in-test; the artifact's meta line
+# double-checks zero recorded violations. ~30s total
+# (docs/usage/static_analysis.md#runtime-sanitizer-graftsan).
 rm -f .graftlint_cache/observed_locks.jsonl
 AUTODIST_SANITIZE=locks,waits,threads JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_data_plane.py \
+    tests/test_reqtrace.py \
     tests/test_serve_fleet.py::test_router_routes_and_spreads \
     tests/test_serve_fleet.py::test_router_sheds_typed_busy_when_all_replicas_full \
     tests/test_serve_fleet.py::test_kill_a_replica_completes_all_requests_zero_failures \
@@ -176,6 +178,10 @@ python bench.py --metrics-overhead
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead
+# Request-trace plane gate: armed lifecycle marks (AUTODIST_REQTRACE=1)
+# must stay within max_overhead_pct of the mean served-request latency
+# through a real router fleet (reqtrace_overhead row).
+python bench.py --reqtrace-overhead
 # Input-data plane gate: under an injected slow host loader the async
 # prefetch producer must beat the synchronous feed by min_ratio steps/s,
 # keep the data_wait share below the data_wait_drift band, keep naming

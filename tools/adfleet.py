@@ -117,6 +117,17 @@ def _row(address: str, status: dict) -> str:
         if isinstance(total, dict):
             cols.append(f"p50 {_fmt_q(_metrics.quantile(total, 0.5))} "
                         f"p99 {_fmt_q(_metrics.quantile(total, 0.99))}")
+        shares = [(p, reg.get(f"serve.attr.{p}"))
+                  for p in ("wire", "queue", "prefill", "decode")]
+        shares = [(p, v) for p, v in shares if isinstance(v, (int, float))]
+        if any(v for _, v in shares):
+            # Compact phase-attribution fingerprint (serve.attr.* — the
+            # reqtrace plane's per-round shares): where this replica's
+            # request time goes, w/q/p/d. Un-armed replicas keep the
+            # column off, like recov/wiresave.
+            cols.append("attr " + "/".join(
+                f"{p[0]}{v:.2f}".replace(f"{p[0]}0.", f"{p[0]}.")
+                for p, v in shares))
         used = reg.get("serve.kv.pages_used")
         free = reg.get("serve.kv.pages_free")
         if isinstance(used, (int, float)) or isinstance(free, (int, float)):

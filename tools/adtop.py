@@ -93,6 +93,28 @@ def _perf_lines(reg: dict) -> list:
     return ["perf     " + "  ".join(head)] if head else []
 
 
+def _req_lines(reg: dict, alerts: dict) -> list:
+    """The serving request-attribution line: per-scheduler-round
+    wire/queue/prefill/decode shares (``serve.attr.*`` — the serving mirror
+    of ``train.attr.*``, same sum-to-1.0 contract), plus the exemplar rid
+    when a firing alert carries one — the alert names a concrete request and
+    this line says where to look (``tools/adtrace.py`` renders it)."""
+    phases = ("wire", "queue", "prefill", "decode")
+    shares = {p: reg.get(f"serve.attr.{p}") for p in phases
+              if isinstance(reg.get(f"serve.attr.{p}"), (int, float))}
+    if not shares:
+        return []
+    line = "req      attr " + " ".join(
+        f"{p} {shares[p]:.2f}".replace(" 0.", " .")
+        for p in phases if p in shares)
+    for a in (alerts.get("active") or []):
+        ex = a.get("exemplar")
+        if isinstance(ex, dict) and ex.get("rid") is not None:
+            line += f"  exemplar {ex['rid']} ({a.get('rule', '?')})"
+            break
+    return [line]
+
+
 def _health_lines(reg: dict) -> list:
     rows = [(k.split("train.health.", 1)[1], v) for k, v in sorted(reg.items())
             if k.startswith("train.health.") and isinstance(v, (int, float))]
@@ -279,6 +301,7 @@ def render(status: dict, address: str = "") -> str:
                              f"{r.get('in_flight', 0)!s:>10} "
                              f"{r.get('queue_depth', 0)!s:>6}  {state}")
     lines.extend(_perf_lines(reg))
+    lines.extend(_req_lines(reg, status.get("alerts") or {}))
     lines.extend(_health_lines(reg))
     lines.extend(_alert_lines(status.get("alerts") or {}))
     lines.extend(_recovery_lines(status))

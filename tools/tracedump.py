@@ -20,7 +20,11 @@ any offset was estimated. ``--events FILE`` (repeatable) merges structured
 registry-event dumps (``telemetry.dump_events_jsonl`` files — the flight
 recorder writes one per snapshot) into the timeline as INSTANT markers on
 their own lane, so anomalies line up against the spans that surround them.
-Load the output in ui.perfetto.dev or chrome://tracing.
+``--reqtrace FILE`` (repeatable) merges request-lifecycle dumps
+(``telemetry.dump_reqtrace_jsonl`` files — the request-trace plane's
+offline exit) as per-request lanes with router->replica flow arrows, so a
+post-mortem gets the same flow-linked timeline ``tools/adtrace.py --out``
+pulls live. Load the output in ui.perfetto.dev or chrome://tracing.
 """
 
 import argparse
@@ -41,12 +45,14 @@ def _parse_offset(spec: str):
             f"--offset wants WID:NANOSECONDS, got {spec!r}")
 
 
-def merge_dumps(out_path: str, inputs, offsets=None, event_files=()) -> str:
+def merge_dumps(out_path: str, inputs, offsets=None, event_files=(),
+                reqtrace_files=()) -> str:
     """Merge span JSONL dumps at ``inputs`` into one Chrome trace at
-    ``out_path``; ``offsets`` maps worker id -> clock_offset_ns override and
+    ``out_path``; ``offsets`` maps worker id -> clock_offset_ns override,
     ``event_files`` are registry-event JSONL dumps overlaid as instant
-    markers. Returns ``out_path`` (the test-facing entry point — main() is
-    argv plumbing around it)."""
+    markers, and ``reqtrace_files`` are request-lifecycle JSONL dumps merged
+    as per-request flow-linked lanes. Returns ``out_path`` (the test-facing
+    entry point — main() is argv plumbing around it)."""
     from autodist_tpu.telemetry import cluster
     offsets = offsets or {}
     states = []
@@ -59,8 +65,16 @@ def merge_dumps(out_path: str, inputs, offsets=None, event_files=()) -> str:
     events = []
     for path in event_files:
         events.extend(cluster.load_events_jsonl(path))
+    req_states = []
+    for path in reqtrace_files:
+        state = cluster.load_reqtrace_jsonl(path)
+        wid = state.get("worker_id")
+        if wid in offsets:
+            state["clock_offset_ns"] = offsets[wid]
+        req_states.append(state)
     return cluster.merge_trace_states(states, out_path,
-                                      instant_events=events)
+                                      instant_events=events,
+                                      reqtrace_states=req_states)
 
 
 def main(argv=None) -> int:
@@ -79,10 +93,15 @@ def main(argv=None) -> int:
                     help="registry-event JSONL dump "
                          "(telemetry.dump_events_jsonl file) to overlay as "
                          "instant markers (repeatable)")
+    ap.add_argument("--reqtrace", action="append", default=[],
+                    metavar="FILE",
+                    help="request-lifecycle JSONL dump "
+                         "(telemetry.dump_reqtrace_jsonl file) to merge as "
+                         "flow-linked per-request lanes (repeatable)")
     args = ap.parse_args(argv)
     try:
         merge_dumps(args.out, args.inputs, offsets=dict(args.offset),
-                    event_files=args.events)
+                    event_files=args.events, reqtrace_files=args.reqtrace)
     except (OSError, ValueError) as e:
         print(f"tracedump: {e}", file=sys.stderr)
         return 1
