@@ -185,6 +185,16 @@ KNOWN_FLAGS = {
                          "`reqtrace` opcode (tools/adtrace.py)",
     "AUTODIST_REQTRACE_RING": "request-trace ring capacity (lifecycle "
                               "records retained per process)",
+    "AUTODIST_MEM_BUDGET": "per-device memory budget override in BYTES for "
+                           "the memory plane (async-PS optimizer rule, "
+                           "autotune OOM pre-flight, pressure fallback) when "
+                           "the backend reports no allocator limit; 0/unset "
+                           "= the warned 8 GiB default",
+    "AUTODIST_MEM_PRESSURE": "memory-pressure ratio (bytes_in_use/"
+                             "bytes_limit, or live/budget on statless "
+                             "backends) past which the mem_pressure rule "
+                             "fires and paged-KV admission holds back "
+                             "reservable pages; default 0.92",
     "AUTODIST_WIRE_DTYPE": "quantized PS gradient push: 'fp16', 'bf16' or "
                            "'int8' compresses eligible gradient leaves on "
                            "the wire (error feedback keeps convergence); "
@@ -385,6 +395,12 @@ _ENV_DEFAULTS = {
     "AUTODIST_WIRE_DTYPE": "",
     "AUTODIST_COMPRESS_MIN_BYTES": 65536,
     "AUTODIST_SPARSE_PUSH": True,
+    # HBM memory plane (telemetry/memplane.py): the budget override only
+    # matters where the backend reports no allocator limit (CPU/sim — the
+    # default is warned once), and the pressure threshold drives both the
+    # shipped mem_pressure alert rule and the paged-KV admission holdback.
+    "AUTODIST_MEM_BUDGET": 0,
+    "AUTODIST_MEM_PRESSURE": 0.92,
 }
 
 class ENV(enum.Enum):
@@ -457,6 +473,8 @@ class ENV(enum.Enum):
     AUTODIST_WIRE_DTYPE = "AUTODIST_WIRE_DTYPE"
     AUTODIST_COMPRESS_MIN_BYTES = "AUTODIST_COMPRESS_MIN_BYTES"
     AUTODIST_SPARSE_PUSH = "AUTODIST_SPARSE_PUSH"
+    AUTODIST_MEM_BUDGET = "AUTODIST_MEM_BUDGET"
+    AUTODIST_MEM_PRESSURE = "AUTODIST_MEM_PRESSURE"
 
     @property
     def val(self):

@@ -262,6 +262,7 @@ class PrefetchProducer:
         self._wait_c = telemetry.counter(f"{metric_prefix}.producer_wait")
         self._batch_c = telemetry.counter(f"{metric_prefix}.producer_batches")
         self._depth_g = telemetry.gauge(f"{metric_prefix}.queue_depth")
+        self._name = name
         self._threads = [
             threading.Thread(target=self._work, daemon=True,
                              name=f"{name}-{i}")
@@ -297,6 +298,15 @@ class PrefetchProducer:
                         with telemetry.span(f"{self._prefix}.prefetch",
                                             seq=seq):
                             value = self._transform(value)
+                        if telemetry.enabled():
+                            # Census claim on the device-staged batch: per-seq
+                            # keys so every in-flight staged item is owned;
+                            # tag() prunes consumed (dead-weakref) claims as
+                            # new ones arrive, so the registry stays bounded
+                            # at roughly the prefetch depth.
+                            from autodist_tpu.telemetry import memplane
+                            memplane.tag("prefetch", value,
+                                         key=f"{self._name}.{seq}")
                     except BaseException as e:  # noqa: BLE001 — same contract
                         with self._src_lock:
                             self._src_done = True

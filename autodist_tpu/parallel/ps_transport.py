@@ -757,6 +757,10 @@ class PSServer:
         # membership generations (same stable-shell contract as alerts).
         from autodist_tpu.parallel import recovery as _recovery
         snap["recovery"] = _recovery.recovery_snapshot()
+        # Memory plane: owner census + budget + pressure (stable empty
+        # shell until the plane arms — same contract as the two above).
+        from autodist_tpu.telemetry import memplane as _memplane
+        snap["memory"] = _memplane.memory_snapshot()
         controller = getattr(self._runner, "controller", None)
         if controller is not None:
             bound = controller.bound

@@ -284,6 +284,12 @@ class SnapshotRing:
         step, state = self._ring[-1]
         return (step, self._copy(state) if self._copy is not None else state)
 
+    def states(self) -> List[Any]:
+        """The retained snapshot states, oldest first — the memory plane's
+        census input (``memplane.tag("snapshots", ring.states())``): the
+        ring's deep copies are pinned device memory no other owner claims."""
+        return [state for _, state in self._ring]
+
     def drop_newest(self):
         """Discard the newest snapshot — it was rolled back to and the SAME
         incident fired again, so it is suspect (a slow-burn anomaly already

@@ -254,6 +254,7 @@ class DistributedRunner:
         # could be recycled by a new fn after the old one (evicted from the
         # step cache) is collected, silently suppressing its compile record.
         self._compile_sigs: set = set()
+        self._mem_analysis_warned: set = set()
         self._fetch_tokens: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
         self._fetch_token_next = 0
@@ -753,14 +754,46 @@ class DistributedRunner:
             out: dict = {"flops": k * flops,
                          "bytes_accessed":
                              k * bytes_acc if bytes_acc > 0 else None}
+            # The memory ledger: the full memory_analysis() record (bytes a
+            # dispatch pins while running — UNscaled by k: the block's
+            # working set does not multiply with its trip count). Optional
+            # on some backends, but named when absent — a silently-None
+            # ledger is how the memory plane goes dark.
+            for field in ("output_bytes", "argument_bytes", "temp_bytes",
+                          "generated_code_bytes"):
+                out[field] = None
             try:
                 mem = compiled.memory_analysis()
                 out["output_bytes"] = int(mem.output_size_in_bytes)
-            except Exception:  # noqa: BLE001 — optional on some backends
-                out["output_bytes"] = None
+                out["argument_bytes"] = int(mem.argument_size_in_bytes)
+                out["temp_bytes"] = int(mem.temp_size_in_bytes)
+                out["generated_code_bytes"] = \
+                    int(mem.generated_code_size_in_bytes)
+            except Exception as e:  # noqa: BLE001 — optional on some backends
+                backend = jax.default_backend()
+                if backend not in self._mem_analysis_warned:
+                    self._mem_analysis_warned.add(backend)
+                    logging.debug(
+                        "memory_analysis() unavailable on the %r backend "
+                        "(%s); the per-program memory ledger will be empty",
+                        backend, e)
             return out
         except Exception:  # noqa: BLE001
             return None
+
+    def _maybe_record_oom(self, where: str, exc: BaseException) -> None:
+        """OOM forensics at the dispatch sites: when a step died of
+        RESOURCE_EXHAUSTED, book the ``mem.oom`` event and trigger the
+        (debounced) flight recorder — whose manifest ``memory`` section is
+        the autopsy: census, program ledger, predicted-vs-live peak. The
+        caller re-raises the real error either way; forensics never mask
+        it (and never fire on non-memory failures)."""
+        try:
+            from autodist_tpu.telemetry import memplane as _memplane
+            if _memplane.is_oom_error(exc):
+                _memplane.record_oom(where, exc)
+        except Exception:  # noqa: BLE001 — diagnostics must never mask
+            pass
 
     def _dispatch_span(self, name: str, kind: str, fetch_fn, batch: PyTree,
                        cost_probe=None, **span_args):
@@ -929,12 +962,16 @@ class DistributedRunner:
         # full dispatch queue — and the first dispatch of a new shape
         # signature is recorded AS compilation (jit.compile span +
         # jit.cache_miss/jit.compile_s counters, see _dispatch_span).
-        with self._dispatch_span("runner.run.dispatch", "step", fetches,
-                                 sharded, cost_probe=(step_fn,
-                                                      (state, sharded))):
-            with self.mesh:
-                new_state, (loss, aux, fetched, bundle) = step_fn(state,
-                                                                  sharded)
+        try:
+            with self._dispatch_span("runner.run.dispatch", "step", fetches,
+                                     sharded, cost_probe=(step_fn,
+                                                          (state, sharded))):
+                with self.mesh:
+                    new_state, (loss, aux, fetched, bundle) = step_fn(state,
+                                                                      sharded)
+        except Exception as e:  # noqa: BLE001 — OOM forensics, then re-raise
+            self._maybe_record_oom("runner.run", e)
+            raise
         if self.health:
             self.last_health = bundle
         default = (loss, aux) if self._has_aux else loss
@@ -971,12 +1008,17 @@ class DistributedRunner:
         many_fn = self._many_fns.get(fetches)
         if many_fn is None:
             many_fn = self._build_many(fetches)
-        with self._dispatch_span("runner.run_many.dispatch", "many", fetches,
-                                 block.tree, steps=block.length,
-                                 cost_probe=(many_fn, (state, block.tree))):
-            with self.mesh:
-                new_state, (losses, auxes, fetched, bundle) = many_fn(
-                    state, block.tree)
+        try:
+            with self._dispatch_span("runner.run_many.dispatch", "many",
+                                     fetches, block.tree, steps=block.length,
+                                     cost_probe=(many_fn,
+                                                 (state, block.tree))):
+                with self.mesh:
+                    new_state, (losses, auxes, fetched, bundle) = many_fn(
+                        state, block.tree)
+        except Exception as e:  # noqa: BLE001 — OOM forensics, then re-raise
+            self._maybe_record_oom("runner.run_many", e)
+            raise
         if self.health:
             self.last_health = bundle
         default = (losses, auxes) if self._has_aux else losses

@@ -227,6 +227,18 @@ class PagedLMEngine:
             {"params": pp}, jnp.zeros((num_pages, 1), jnp.int32),
             decode=True, mutable=["cache"])
         self._pool = variables["cache"]
+        # Census claim on the page pool. STATIC bytes, not weakrefs: the
+        # pool is a fixed-size preallocation whose leaves are replaced by
+        # every prefill/decode dispatch — a weakref claim would go dead on
+        # the first step, while the footprint it names never changes.
+        try:
+            from autodist_tpu.telemetry import memplane
+            pool_bytes = sum(
+                int(getattr(leaf, "nbytes", 0) or 0)
+                for leaf in jax.tree_util.tree_leaves(self._pool))
+            memplane.tag("kv_pages", pool_bytes, key=f"pool.{id(self)}")
+        except Exception:  # noqa: BLE001 — census is best-effort
+            pass
         self._set_gauges()
 
     # ------------------------------------------------------------- jit cache
@@ -390,18 +402,32 @@ class PagedLMEngine:
         budget ignores possible prefix sharing — conservative, so a lazy
         draw can never fail; ``admit`` returns the savings. ``rid`` is the
         request's trace key; when the gate holds the request back, an
-        ``admit_wait`` mark records the page shortfall against it."""
+        ``admit_wait`` mark records the page shortfall against it.
+
+        Under device memory pressure (the memory plane's ``mem.pressure``
+        at/above its threshold) the gate demands ``total`` plus a holdback
+        (:func:`~autodist_tpu.telemetry.memplane.kv_admission_holdback`)
+        before admitting — NEW requests shed first while in-flight
+        reservations keep their whole budget, so pressure degrades
+        admission throughput instead of corrupting mid-decode draws."""
         total = self._pages_total(prompt_len, max_new_tokens)
         if total > self._alloc.usable:
             raise ServeError(
                 f"request needs {total} KV pages but the pool owns only "
                 f"{self._alloc.usable} (page_len={self.page_len})")
-        if not self._alloc.can_reserve(total):
-            self._evict_for(total)
-        if not self._alloc.can_reserve(total):
+        holdback = 0
+        try:
+            from autodist_tpu.telemetry import memplane
+            holdback = memplane.kv_admission_holdback(self._alloc.usable)
+        except Exception:  # noqa: BLE001 — pressure probe must not gate
+            holdback = 0
+        if not self._alloc.can_reserve(total + holdback):
+            self._evict_for(total + holdback)
+        if not self._alloc.can_reserve(total + holdback):
             if rid is not None:
                 _reqtrace.mark(rid, "admit_wait", pages_needed=total,
-                               pages_free=self._alloc.free_count())
+                               pages_free=self._alloc.free_count(),
+                               holdback=holdback)
             return False
         self._alloc.reserve(total)
         self._pending.append((prompt_len, max_new_tokens, total))

@@ -507,6 +507,7 @@ class RouterServer:
         adtop/adfleet render a router endpoint next to its replicas."""
         from autodist_tpu.parallel import recovery as _rec
         from autodist_tpu.telemetry import alerts as _alerts
+        from autodist_tpu.telemetry import memplane as _memplane
         return {"registry": telemetry.snapshot(),
                 "wire": self.wire.snapshot(),
                 "uptime_s": round(time.monotonic() - self._t_started, 3),
@@ -514,6 +515,7 @@ class RouterServer:
                 "replicas": self._router.fleet_snapshot(),
                 "alerts": _alerts.alerts_snapshot(),
                 "recovery": _rec.recovery_snapshot(),
+                "memory": _memplane.memory_snapshot(),
                 "events": telemetry.events()}
 
     def _dispatch(self, msg, sp=None):

@@ -199,6 +199,10 @@ class InferenceServer:
         # a co-located trainer's records render identically either way).
         from autodist_tpu.parallel import recovery as _recovery
         snap["recovery"] = _recovery.recovery_snapshot()
+        # Memory plane: the serving census is the paged-KV pool claim plus
+        # pressure — the ratio the admission holdback reflex reads.
+        from autodist_tpu.telemetry import memplane as _memplane
+        snap["memory"] = _memplane.memory_snapshot()
         return snap
 
     def _wait(self, req, timeout) -> tuple:

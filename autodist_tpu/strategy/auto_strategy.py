@@ -50,16 +50,21 @@ _DEFAULT_BUDGET_BYTES = 8 << 30     # conservative HBM fallback when undiscovera
 
 
 def _device_memory_budget() -> int:
-    """Usable per-device memory: 80% of the backend-reported limit, else 8 GiB."""
+    """Usable per-device memory through the memory plane
+    (:func:`autodist_tpu.telemetry.memplane.device_budget`): 80% of the
+    measured allocator limit, else the ``AUTODIST_MEM_BUDGET`` override,
+    else a WARNED 8 GiB default — with the winning source booked as
+    ``mem.budget_source``, so the async-PS memory rule never again runs on
+    a budget nobody saw (the old ``memory_stats() or {}`` silently fell
+    through to the default on every CPU/sim backend)."""
     try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-        limit = int(stats.get("bytes_limit", 0))
-        if limit > 0:
-            return int(limit * 0.8)
-    except Exception:  # noqa: BLE001 — CPU/sim backends report nothing
-        pass
-    return _DEFAULT_BUDGET_BYTES
+        from autodist_tpu.telemetry import memplane
+        budget, _source = memplane.device_budget()
+        return budget
+    except Exception as e:  # noqa: BLE001 — strategy choice must not die
+        logging.debug("memory-plane budget unavailable (%s); using the "
+                      "%d GiB default", e, _DEFAULT_BUDGET_BYTES >> 30)
+        return _DEFAULT_BUDGET_BYTES
 
 
 def _fmt_bytes(n: int) -> str:

@@ -150,6 +150,10 @@ class Candidate:
     predicted: Optional[Dict[str, Any]] = None   # costmodel.predict output
     pruned: Optional[str] = None          # prune reason, None = survivor
     probe: Optional[CandidateResult] = None      # stage-2 measurement
+    # Analytic per-device resident bytes (params + effective opt state +
+    # accumulation buffer) — the memory pre-flight's refusal basis and
+    # costmodel.predict's ``resident_bytes`` term (-> peak_hbm_bytes).
+    resident_bytes: Optional[int] = None
 
     @property
     def name(self) -> str:
@@ -451,6 +455,7 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
     line naming how many were dropped — a silent cap would read as
     "searched everything" when it didn't."""
     from autodist_tpu.strategy.auto_strategy import (_device_memory_budget,
+                                                     _fmt_bytes,
                                                      _opt_state_bytes)
     from autodist_tpu.strategy.partition_utils import partitionable_axis
 
@@ -537,7 +542,47 @@ def enumerate_candidates(model_spec, resource_spec: ResourceSpec,
             "(AUTODIST_TUNE_BUDGET) — raise the budget to rank the rest",
             len(out), budget)
         out = out[:budget]
+    # ---- memory pre-flight: refuse never-fit candidates HERE, before any
+    # stage-1 compile probe spends a compile (and possibly an allocator
+    # OOM) on a program whose resident state alone exceeds the budget.
+    # The budget is the memory plane's (measured x 0.8 / env / warned
+    # default); the refusal reason renders as ``pruned: oom: ...`` in
+    # TunedPlan.explain().
+    part_bytes = sum(s.byte_size for s in partitioned)
+    for c in out:
+        d_bytes = dense_bytes
+        if c.builder_spec["name"].startswith("Partitioned") and n_dev > 1:
+            # Partition-eligible params live sharded 1/n_dev per device.
+            d_bytes = dense_bytes - part_bytes + part_bytes // n_dev
+        c.resident_bytes = _predicted_resident_bytes(
+            c, d_bytes, opt_bytes, n_dev)
+        if c.resident_bytes > budget_bytes:
+            c.pruned = (
+                f"oom: predicted resident {_fmt_bytes(c.resident_bytes)} "
+                f"exceeds the per-device budget {_fmt_bytes(budget_bytes)}"
+                f" — refused before the compile probe")
     return out
+
+
+def _predicted_resident_bytes(cand: Candidate, dense_bytes: int,
+                              opt_bytes: Optional[int], n_dev: int) -> int:
+    """A candidate's analytic per-device resident bytes: params + the
+    optimizer state its knobs leave on-device (ZeRO shards it ``1/n_dev``;
+    the async regime moves it to the PS servers entirely, leaving params +
+    the pushed gradient) + one dense gradient buffer when accumulating.
+    ``opt_bytes`` is the exact eval_shape footprint when known, else the
+    Adam-shaped 2x-params fallback. Program temporaries are NOT included —
+    they come from the compiled ledger (``costmodel.predict``'s
+    ``peak_hbm_bytes``), which this pre-flight deliberately precedes."""
+    opt_eff = opt_bytes if opt_bytes is not None else 2 * dense_bytes
+    if cand.asynchronous:
+        return int(2 * dense_bytes)
+    if cand.zero:
+        opt_eff = opt_eff // max(1, n_dev)
+    resident = dense_bytes + opt_eff
+    if cand.accumulation_steps > 1:
+        resident += dense_bytes
+    return int(resident)
 
 
 # ------------------------------------------------------------------ stage 1
@@ -627,6 +672,10 @@ def _probe_base_costs(cands: List[Candidate], loss_fn, params, optimizer,
     base_costs: Dict[Tuple, Any] = {}
     sync_ps_cost = None
     for cand in cands:
+        if cand.pruned:
+            # Memory pre-flight refusal: spend ZERO compile probes on a
+            # base every surviving candidate has already walked away from.
+            continue
         key = cand.base_key()
         if key in base_costs:
             continue
@@ -760,6 +809,8 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                 cands, loss_fn, params, optimizer, example_batch,
                 resource_spec, sparse_names, has_aux)
             for c in cands:
+                if c.pruned:
+                    continue   # memory pre-flight refusal: keep its reason
                 base = base_costs.get(c.base_key())
                 if not isinstance(base, dict):
                     c.pruned = str(base)
@@ -771,7 +822,8 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                     comm_bytes_per_step=comm_bytes,
                     loader_s_per_step=loader_s_per_step,
                     prefetch_depth=c.prefetch_depth,
-                    quantize_bytes_per_step=quantize_bytes)
+                    quantize_bytes_per_step=quantize_bytes,
+                    resident_bytes=float(c.resident_bytes or 0))
         predicted = [c for c in cands if c.predicted is not None]
         if not predicted:
             raise RuntimeError(
@@ -806,9 +858,13 @@ def autotune(loss_fn: Callable, params: Any, optimizer, example_batch: Any, *,
                     probed_programs[program] = c
         telemetry.gauge("tune.candidates").set(len(cands))
         # Gauges must reconcile: candidates = pruned + measured-directly
-        # (survivors) + measured-via-twin (probe sharers).
+        # (survivors) + measured-via-twin (probe sharers). The oom subset
+        # of pruned gets its own gauge — pre-flight refusals are the
+        # memory plane's work, not the cost ranking's.
         telemetry.gauge("tune.pruned").set(
             len(cands) - len(survivors) - len(probe_sharers))
+        telemetry.gauge("tune.pruned_oom").set(
+            sum(1 for c in cands if (c.pruned or "").startswith("oom")))
 
         # ---- stage 2: measure the survivors with real steps
         for c in survivors:

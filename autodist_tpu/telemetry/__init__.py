@@ -52,6 +52,15 @@ Three planes, one subsystem (docs/usage/observability.md):
   recorder, and honors ``AUTODIST_ALERT_ACTION``); ``tools/adfleet.py``
   merges ``status`` across N endpoints into one fleet screen.
 
+- **Memory plane** (:mod:`autodist_tpu.telemetry.memplane`) — an
+  owner-attributed HBM census (``mem.owned.*`` from weakref claims the
+  train loop / paged-KV engine / prefetch producers register), a budget
+  with a booked source (measured / env / warned default), the
+  ``mem.pressure`` ratio the shipped ``mem_pressure`` alert rule
+  thresholds, tuner memory pre-flight (``pruned: oom`` before any compile
+  probe), and OOM forensics (a ``memory`` section in every flight-recorder
+  manifest: census + per-program ledger + predicted-vs-live peak).
+
 Everything is OFF by default; ``AUTODIST_TELEMETRY=1`` (or
 :func:`telemetry.enable`) turns recording on. Disabled-mode instrumentation
 costs one attribute check per span (gated in ``bench.py
@@ -59,7 +68,8 @@ costs one attribute check per span (gated in ``bench.py
 per train step (``bench.py --health-overhead`` gates the enabled side).
 """
 
-from autodist_tpu.telemetry import alerts, history, openmetrics, reqtrace
+from autodist_tpu.telemetry import (alerts, history, memplane, openmetrics,
+                                    reqtrace)
 from autodist_tpu.telemetry.alerts import (AlertEngine, AlertHalt,
                                            AlertRecover, AlertRule)
 from autodist_tpu.telemetry.cluster import (collect_cluster_trace,
@@ -112,7 +122,7 @@ __all__ = [
     "build_manifest",
     "profiling", "costmodel", "peak_spec", "profile_document",
     "write_profile",
-    "alerts", "history", "openmetrics",
+    "alerts", "history", "memplane", "openmetrics",
     "AlertEngine", "AlertHalt", "AlertRecover", "AlertRule",
     "MetricsHistory",
     "MetricsExporter", "quantile", "merge_histograms",

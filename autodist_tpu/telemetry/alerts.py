@@ -91,6 +91,15 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {"name": "mfu_collapse", "kind": "drift", "metric": "train.mfu",
      "ref_from": "window_max", "window_s": 600.0, "band": 0.5,
      "relative": True, "direction": "below"},
+    # Memory pressure: the memory plane's worst-device used/limit ratio
+    # (mem.pressure, booked by every sample_device_memory pass) held above
+    # the default AUTODIST_MEM_PRESSURE threshold for 30s. Sustained, not
+    # a spike: one fragmentation burp at a compile boundary should not
+    # page. On serving kinds the same plane also tightens paged-KV
+    # admission (memplane.kv_admission_holdback) — the rule is the page,
+    # the holdback is the reflex.
+    {"name": "mem_pressure", "kind": "threshold", "metric": "mem.pressure",
+     "op": ">", "value": 0.92, "for_s": 30.0},
 ]
 
 

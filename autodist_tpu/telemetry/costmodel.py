@@ -132,7 +132,8 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
             comm_bytes_per_step: float = 0.0,
             loader_s_per_step: float = 0.0,
             prefetch_depth: int = 0,
-            quantize_bytes_per_step: float = 0.0) -> Dict[str, Any]:
+            quantize_bytes_per_step: float = 0.0,
+            resident_bytes: float = 0.0) -> Dict[str, Any]:
     """Predict per-step time for a candidate plan's program set.
 
     ``plan_costs``: one program-cost dict or an iterable of them — the
@@ -162,19 +163,37 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
     host + comm per step) — the steady-state bound: a pipeline of any
     depth >= 1 sustains ``max(rest_s, loader_s)`` per step.
 
-    Returns ``{"step_s", "steps_per_s", "bound", "breakdown": {compute_s,
-    memory_s, host_s, comm_s, quantize_s, data_wait_s per step}}`` —
-    ``bound`` names the binding resource, the MLPerf-style "what do I fix
-    first" answer."""
+    ``resident_bytes`` is the plan's per-device resident state (params +
+    optimizer state + whatever else stays allocated across steps); with it
+    the prediction also carries ``peak_hbm_bytes`` — resident plus the
+    WORST program's transient working set (``max`` over the records'
+    ``temp_bytes``, falling back to ``argument_bytes + output_bytes`` when
+    the backend reported no temp ledger) — the fit estimate the autotuner's
+    OOM pre-flight prices against the device budget before spending a
+    compile probe.
+
+    Returns ``{"step_s", "steps_per_s", "bound", "peak_hbm_bytes",
+    "breakdown": {compute_s, memory_s, host_s, comm_s, quantize_s,
+    data_wait_s per step}}`` — ``bound`` names the binding resource, the
+    MLPerf-style "what do I fix first" answer (``peak_hbm_bytes`` is None
+    when neither resident bytes nor any memory ledger was given)."""
     if isinstance(plan_costs, dict):
         plan_costs = [plan_costs]
     compute_s = memory_s = device_s = 0.0
     host_s = 0.0
     total_steps = 0
+    peak_temp: Optional[float] = None
     for rec in plan_costs:
         n = max(1, int(rec.get("dispatches") or 1))
         steps = int(rec.get("steps") or 1)
         total_steps += n * steps
+        temp = rec.get("temp_bytes")
+        if temp is None and (rec.get("argument_bytes") is not None
+                             or rec.get("output_bytes") is not None):
+            temp = (rec.get("argument_bytes") or 0) \
+                + (rec.get("output_bytes") or 0)
+        if temp is not None:
+            peak_temp = max(peak_temp or 0.0, float(temp))
         c = (rec.get("flops") or 0.0) / calib.flops_per_s \
             if calib.flops_per_s else 0.0
         m = (rec.get("bytes_accessed") or 0.0) / calib.bytes_per_s \
@@ -210,9 +229,13 @@ def predict(plan_costs: Union[Dict[str, Any], Iterable[Dict[str, Any]]],
                 ("quantize", breakdown["quantize_s"]),
                 ("data_wait", breakdown["data_wait_s"]),
                 key=lambda kv: kv[1])[0] if step_s > 0 else "unknown"
+    peak_hbm = None
+    if resident_bytes or peak_temp is not None:
+        peak_hbm = int(float(resident_bytes or 0.0) + (peak_temp or 0.0))
     return {"step_s": step_s,
             "steps_per_s": (1.0 / step_s) if step_s > 0 else None,
             "bound": bound,
+            "peak_hbm_bytes": peak_hbm,
             "breakdown": breakdown}
 
 

@@ -115,11 +115,21 @@ def sample_device_memory(opt_state=None) -> int:
     With ``opt_state``, additionally writes ``train.opt_state_bytes`` — the
     per-device optimizer-state footprint (:func:`opt_state_bytes`), the gauge
     ZeRO weight-update sharding divides by the data-parallel size.
+
+    The memory plane's attribution pass rides the same sample: the live
+    bytes are decomposed over the :mod:`~autodist_tpu.telemetry.memplane`
+    tag registry into ``mem.owned.{params,opt_state,kv_pages,prefetch,
+    snapshots,other}`` gauges (``other`` = live minus claimed, the
+    leak-hunting residual, clamped at zero) plus the ``mem.pressure``
+    ratio the shipped ``mem_pressure`` alert rule thresholds — so owners
+    and pressure flow into history shards, OpenMetrics, and adfleet with
+    no extra sampling path.
     Called by ``train()`` at log boundaries when telemetry is enabled; a
     diagnostics sampler must never break training, so backend hiccups are
     swallowed at debug level."""
     import jax
     wrote = 0
+    live_bytes = 0
     if opt_state is not None:
         try:
             _metrics.gauge("train.opt_state_bytes").set(
@@ -129,12 +139,22 @@ def sample_device_memory(opt_state=None) -> int:
             logging.debug("opt-state byte sampling unavailable: %s", e)
     try:
         live = jax.live_arrays()
+        live_bytes = int(sum(int(getattr(a, "nbytes", 0) or 0)
+                             for a in live))
         _metrics.gauge("device.live_buffers").set(len(live))
-        _metrics.gauge("device.live_bytes").set(
-            int(sum(int(getattr(a, "nbytes", 0) or 0) for a in live)))
+        _metrics.gauge("device.live_bytes").set(live_bytes)
         wrote += 2
     except (RuntimeError, ValueError, TypeError, AttributeError) as e:
         logging.debug("live-array sampling unavailable: %s", e)
+    try:
+        from autodist_tpu.telemetry import memplane as _memplane
+        for owner, nbytes in _memplane.attribute(live_bytes).items():
+            _metrics.gauge(f"mem.owned.{owner}").set(int(nbytes))
+            wrote += 1
+        _memplane.current_pressure(max_age_s=0.0)   # books mem.pressure
+        wrote += 1
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        logging.debug("memory attribution unavailable: %s", e)
     try:
         devices = jax.local_devices()
     except RuntimeError as e:  # backend not initialized yet

@@ -185,13 +185,24 @@ class ProgramCost:
     compile_s: Optional[float] = None
     dispatches: int = 0
     source: Optional[str] = None    # "xla" | "analytic" | None (unknown)
+    # The memory ledger: XLA's full memory_analysis() per program — the
+    # bytes a dispatch pins while it runs (arguments + outputs + temps +
+    # code), UNscaled by steps (unlike flops, a K-step block's working set
+    # does not multiply). ``temp_bytes`` is the term the cost model adds to
+    # resident state for its peak-HBM estimate.
+    argument_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {"kind": self.kind, "steps": self.steps, "flops": self.flops,
                 "bytes_accessed": self.bytes_accessed,
                 "output_bytes": self.output_bytes,
                 "compile_s": self.compile_s, "dispatches": self.dispatches,
-                "source": self.source}
+                "source": self.source,
+                "argument_bytes": self.argument_bytes,
+                "temp_bytes": self.temp_bytes,
+                "generated_code_bytes": self.generated_code_bytes}
 
 
 class _State:
@@ -303,9 +314,10 @@ def record_program_cost(sig: str, kind: str, steps: int,
                         compile_s: Optional[float] = None) -> ProgramCost:
     """Attach a compiled program's static costs to its signature record
     (creating it if the dispatch count never touched it). ``cost`` is the
-    runner-extracted ``{"flops", "bytes_accessed", "output_bytes"}`` dict, or
-    None when the backend reported nothing — the analytic fallback (scaled by
-    ``steps``) stands in then."""
+    runner-extracted ``{"flops", "bytes_accessed", "output_bytes"}`` dict
+    (plus the ``argument_bytes``/``temp_bytes``/``generated_code_bytes``
+    memory ledger), or None when the backend reported nothing — the analytic
+    fallback (scaled by ``steps``) stands in then."""
     with _STATE.lock:
         rec = _STATE.costs.get(sig)
         if rec is None:
@@ -315,6 +327,13 @@ def record_program_cost(sig: str, kind: str, steps: int,
         rec.steps = int(steps)
         if compile_s is not None:
             rec.compile_s = float(compile_s)
+        if cost:
+            # The memory ledger rides independently of the flops report: a
+            # pallas-opaque program can still name its working set.
+            for field in ("argument_bytes", "temp_bytes",
+                          "generated_code_bytes"):
+                if cost.get(field) is not None:
+                    setattr(rec, field, int(cost[field]))
         analytic = None
         if _STATE.analytic_flops_per_step is not None:
             analytic = float(_STATE.analytic_flops_per_step) * int(steps)

@@ -106,6 +106,16 @@ def build_manifest(reason: str, seq: Optional[int] = None) -> Dict[str, Any]:
             manifest["recovery"] = rec
     except Exception:   # diagnostics must never fail the snapshot
         pass
+    try:
+        # The memory plane's forensics record: owner census, per-program
+        # ledger, recent device.mem history and the predicted-vs-live peak
+        # delta — an OOM-triggered snapshot names the dominant owner from
+        # the manifest alone. Present when the plane is armed (claims
+        # exist or spans are on); a stable empty shell otherwise.
+        from autodist_tpu.telemetry import memplane as _memplane
+        manifest["memory"] = _memplane.memory_section()
+    except Exception:   # diagnostics must never fail the snapshot
+        pass
     return manifest
 
 

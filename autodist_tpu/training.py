@@ -21,6 +21,7 @@ from autodist_tpu.runner import MicroBatched, TrainState
 from autodist_tpu.testing import faults as _faults
 from autodist_tpu.telemetry import health as _health
 from autodist_tpu.telemetry import history as _history
+from autodist_tpu.telemetry import memplane as _memplane
 from autodist_tpu.telemetry import openmetrics as _openmetrics
 from autodist_tpu.telemetry import profiling as _profiling
 from autodist_tpu.utils import logging
@@ -494,7 +495,12 @@ def _per_step_loop(runner, state: TrainState, feed, next_batch, batch_iter,
                 if telemetry.enabled():
                     # Memory gauges first so the snapshot emitted below
                     # carries this boundary's live-buffer/HBM readings (and
-                    # the opt-state footprint ZeRO sharding divides).
+                    # the opt-state footprint ZeRO sharding divides). The
+                    # census tags re-point at THIS boundary's state — the
+                    # step donates its inputs, so last boundary's claims
+                    # are dead weakrefs by now.
+                    _memplane.tag("params", state.params)
+                    _memplane.tag("opt_state", state.opt_state)
                     telemetry.sample_device_memory(opt_state=state.opt_state)
                     telemetry.emit_metrics(global_step=step_i + 1)
                 if monitor is not None:
@@ -520,6 +526,10 @@ def _per_step_loop(runner, state: TrainState, feed, next_batch, batch_iter,
                 # bare reference would be deleted by the next dispatch.
                 if ring is not None:
                     ring.push(step_i + 1, state)
+                    if telemetry.enabled():
+                        # Ring census: the deep-copied snapshot states are
+                        # pinned device memory nothing else accounts for.
+                        _memplane.tag("snapshots", ring.states())
                 if on_metrics is not None:
                     on_metrics(step_i + 1, float(loss), rate)
         if (eval_every and (step_i + 1) % eval_every == 0
@@ -744,7 +754,10 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                 if telemetry.enabled():
                     # Memory gauges first so the emitted snapshot carries
                     # this boundary's live-buffer/HBM readings (and the
-                    # opt-state footprint ZeRO sharding divides).
+                    # opt-state footprint ZeRO sharding divides); census
+                    # tags re-pointed first, as in the per-step loop.
+                    _memplane.tag("params", state.params)
+                    _memplane.tag("opt_state", state.opt_state)
                     telemetry.sample_device_memory(opt_state=state.opt_state)
                     telemetry.emit_metrics(global_step=step_i)
                 if monitor is not None:
@@ -765,6 +778,8 @@ def _unrolled_loop(runner, state: TrainState, next_batch, batch_iter,
                 # survive the step's buffer donation).
                 if ring is not None:
                     ring.push(step_i, state)
+                    if telemetry.enabled():
+                        _memplane.tag("snapshots", ring.states())
                 if on_metrics is not None:
                     on_metrics(step_i, last, rate)
         if eval_every and step_i % eval_every == 0:

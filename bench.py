@@ -577,6 +577,140 @@ def attr_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
     return result
 
 
+def mem_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
+    """Memory-plane cost micro-bench (the CPU transformer micro-model,
+    host-dispatch-bound — where any per-boundary cost is most visible):
+
+    - steps/s through ``runner.run`` with the plane IDLE (no claims, no
+      attribution — the production default) and ARMED (the train loop's
+      boundary work: re-tag params + opt_state census claims and one
+      ``sample_device_memory`` pass, whose attribution decomposes the live
+      bytes over the claims and books ``mem.owned.*`` + ``mem.pressure``),
+      best of ``rounds`` interleaved rounds;
+    - the DIRECT armed-side costs, machine-relative so they gate
+      everywhere: ``tag_ms`` (one params + opt_state re-tag — tree walk +
+      weakref registration) and ``sample_ms`` (one full
+      ``sample_device_memory`` with the attribution pass), combined as
+      ``overhead_pct`` = (tag_ms + sample_ms) / log_every over the
+      measured idle step time. The gated number: the ``mem_overhead`` row
+      in PERF_BASELINE.json carries ``max_overhead_pct`` (2.0) — the
+      census growing past ~2% of a host-bound step means attribution
+      stopped being one live-array walk over a handful of claims.
+    """
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry import memplane
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    cfg = transformer_lm.TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, dtype=jnp.float32, tied_output=False)
+    batch_size, seq_len = 8 * n_dev, 16
+    model, params = transformer_lm.init_params(cfg)
+    loss_fn = transformer_lm.make_loss_fn(model)
+    batch = transformer_lm.synthetic_batch(cfg, batch_size=batch_size,
+                                           seq_len=seq_len)
+    ad = AutoDist(strategy_builder=AllReduce())
+    runner = ad.create_distributed_session(loss_fn, params, optax.adam(1e-3),
+                                           example_batch=batch)
+    state = runner.init(params)
+
+    def measure(n, boundary=False):
+        nonlocal state
+        loss = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            state, loss = runner.run(state, batch)
+            if boundary and (i + 1) % log_every == 0:
+                # The boundary work an armed train() period pays, at the
+                # period rate, inside the timed window: re-point the
+                # census claims at this boundary's (donation-fresh) state
+                # and run the sampler whose attribution pass walks them.
+                memplane.tag("params", state.params)
+                memplane.tag("opt_state", state.opt_state)
+                telemetry.sample_device_memory(opt_state=state.opt_state)
+        _ = jax.device_get(loss)   # completion fence
+        return n / (time.perf_counter() - t0)
+
+    try:
+        measure(10)                         # compile + warmup
+        measure(log_every, boundary=True)   # warm the boundary path too
+        best = {"disabled": 0.0, "enabled": 0.0}
+        for _ in range(rounds):    # interleaved: load noise hits both sides
+            best["disabled"] = max(best["disabled"], measure(steps))
+            best["enabled"] = max(best["enabled"],
+                                  measure(steps, boundary=True))
+
+        # Direct boundary costs (min of rounds — load stretches, never
+        # shrinks).
+        tag_ms = sample_ms = math.inf
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            memplane.tag("params", state.params)
+            memplane.tag("opt_state", state.opt_state)
+            tag_ms = min(tag_ms, (time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            telemetry.sample_device_memory(opt_state=state.opt_state)
+            sample_ms = min(sample_ms, (time.perf_counter() - t0) * 1e3)
+        census = memplane.census()
+    finally:
+        memplane.reset()
+
+    step_ms = 1e3 / best["disabled"]
+    overhead_pct = 100.0 * (tag_ms + sample_ms) / log_every / step_ms
+
+    result = {
+        "metric": f"mem_overhead ({platform} x{n_dev}, d{cfg.d_model}"
+                  f"x{cfg.n_layers}, seq{seq_len}, bs{batch_size}, "
+                  f"log_every {log_every})",
+        "unit": "steps/s",
+        "rows": {"disabled": round(best["disabled"], 2),
+                 "enabled": round(best["enabled"], 2)},
+        "enabled_vs_disabled": round(best["enabled"] / best["disabled"], 4),
+        "tag_ms": round(tag_ms, 4),
+        "sample_ms": round(sample_ms, 4),
+        "owners": sorted(census),
+        "overhead_pct": round(overhead_pct, 4),
+    }
+    try:
+        with open(_baseline_path()) as f:
+            recorded = json.load(f).get("mem_overhead")
+        if recorded:
+            max_pct = recorded.get("max_overhead_pct", 2.0)
+            if overhead_pct > max_pct:
+                print(f"WARNING: the memory plane costs "
+                      f"{overhead_pct:.3f}% of a host-bound step, above the "
+                      f"{max_pct}% gate — census tagging or the attribution "
+                      f"pass got costlier (see PERF_BASELINE.json "
+                      f"mem_overhead)", file=sys.stderr)
+            floor = recorded.get("enabled_vs_disabled_floor")
+            if (floor and recorded.get("platform") == platform
+                    and result["enabled_vs_disabled"] < floor):
+                print(f"WARNING: census-armed steps/s is "
+                      f"{result['enabled_vs_disabled']:.2f}x the idle "
+                      f"rate, below the recorded {floor:.2f}x floor (see "
+                      f"PERF_BASELINE.json mem_overhead)",
+                      file=sys.stderr)
+    except (OSError, KeyError, ValueError, TypeError, ZeroDivisionError):
+        pass  # a missing/mangled snapshot must not break the bench
+    print(json.dumps(result))
+    _append_trajectory({"metric": result["metric"],
+                        "steps_per_s": result["rows"]["disabled"],
+                        "unit": "steps/s",
+                        "tag_ms": result["tag_ms"],
+                        "sample_ms": result["sample_ms"],
+                        "overhead_pct": result["overhead_pct"]})
+    return result
+
+
 def metrics_overhead(steps: int = 120, log_every: int = 40, rounds: int = 3):
     """Fleet-metrics-plane cost micro-bench (the CPU transformer micro-model,
     host-dispatch-bound — where any per-boundary cost is most visible):
@@ -2213,6 +2347,13 @@ def main(argv=None):
              "against max_overhead_pct in the PERF_BASELINE.json "
              "metrics_overhead row")
     parser.add_argument(
+        "--mem-overhead", action="store_true",
+        help="measure the memory plane's cost on the CPU micro-model: "
+             "steps/s with the census idle vs armed (params + opt_state "
+             "re-tag and one attributed sample_device_memory per boundary) "
+             "plus the direct per-boundary tag/sample costs, gated against "
+             "max_overhead_pct in the PERF_BASELINE.json mem_overhead row")
+    parser.add_argument(
         "--trace-pull-overhead", action="store_true",
         help="measure the cluster trace plane's pull cost: fill the span "
              "ring to capacity, report the chief-side snapshot+encode stall "
@@ -2303,6 +2444,9 @@ def main(argv=None):
         return
     if args.metrics_overhead:
         metrics_overhead()
+        return
+    if args.mem_overhead:
+        mem_overhead()
         return
     if args.trace_pull_overhead:
         trace_pull_overhead()

@@ -175,6 +175,11 @@ rm -rf "$ADPROF_SMOKE_DIR"
 # amortized over a log period, must stay within max_overhead_pct of a
 # host-bound step (metrics_overhead row).
 python bench.py --metrics-overhead
+# Memory plane gate: the census re-tag (params + opt_state weakref claims)
+# plus one attributed sample_device_memory pass, amortized over a log
+# period, must stay within max_overhead_pct of a host-bound step
+# (mem_overhead row).
+python bench.py --mem-overhead
 # Cluster trace plane gate: a full-ring `trace` pull's chief-side
 # snapshot+encode must stay under max_stall_ms (trace_pull row).
 python bench.py --trace-pull-overhead

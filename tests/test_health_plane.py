@@ -175,9 +175,14 @@ def test_snapshot_dir_schema_pinned(tmp_path):
     assert sorted(os.listdir(path)) == sorted(recorder.SNAPSHOT_FILES)
     manifest = json.load(open(os.path.join(path, "manifest.json")))
     for key in ("reason", "seq", "t_wall_s", "host", "pid", "flags",
-                "versions", "files"):
+                "versions", "files", "memory"):
         assert key in manifest
     assert manifest["reason"] == "schema_pin"
+    # The PR 20 memory section: snapshot shell + forensics extras, present
+    # in EVERY manifest (a stable empty shell when the plane never armed).
+    assert {"owned", "live_bytes", "pressure", "budget_bytes",
+            "budget_source", "devices", "programs",
+            "history"} <= set(manifest["memory"])
     metrics = json.load(open(os.path.join(path, "metrics.json")))
     assert isinstance(metrics, dict)
     doc = json.load(open(os.path.join(path, "trace.json")))
@@ -363,6 +368,11 @@ def test_status_and_record_opcodes_over_loopback(tmp_path):
             "generations"}
         assert set(status["recovery"]["counts"]) == {
             "evicted", "rejoined", "rollbacks", "respawns"}
+        # The PR 20 memory section (same stable-shell contract — pinned by
+        # SHAPE: armed runs fill the values, unarmed ones ship zeros).
+        assert set(status["memory"]) == {
+            "owned", "live_bytes", "pressure", "budget_bytes",
+            "budget_source", "devices"}
         from autodist_tpu.telemetry import alerts as _alerts
         eng = _alerts.AlertEngine(rules=[_alerts.AlertRule(
             name="pin", kind="threshold", metric="train.mfu", op=">",

@@ -149,6 +149,20 @@ def _row(address: str, status: dict) -> str:
             # nonzero column is the signal to raise max_replicas or shrink
             # the offered load, BEFORE p99 melts.
             cols.append(f"shed {int(shed)}")
+    mem = status.get("memory") or {}
+    if mem.get("live_bytes") or mem.get("owned"):
+        # Memory-plane fingerprint: worst-device HBM used vs the booked
+        # budget (the mem.pressure ratio's own numbers). Processes whose
+        # plane never armed keep the column off, like recov/wiresave.
+        devs = mem.get("devices") or {}
+        used = max((d.get("bytes_in_use", 0) for d in devs.values()),
+                   default=mem.get("live_bytes", 0))
+        limit = max((d.get("bytes_limit", 0) for d in devs.values()),
+                    default=mem.get("budget_bytes", 0))
+        col = f"hbm {_fmt_bytes(used)}"
+        if limit:
+            col += f"/{_fmt_bytes(limit)}"
+        cols.append(col)
     active = (status.get("alerts") or {}).get("active") or []
     if active:
         cols.append("ALERT " + ",".join(sorted(a.get("rule", "?")

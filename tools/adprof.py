@@ -60,6 +60,17 @@ def _fmt_pct(x) -> str:
     return f"{100.0 * x:.1f}%" if x is not None else "n/a"
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{int(n)}B" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
 def _phase_seconds(summary: dict) -> dict:
     """Per-step seconds each phase costs: share x step_s (0.0 when the
     profile recorded no periods)."""
@@ -98,6 +109,30 @@ def summarize(doc: dict) -> list:
             f"flops n/a ")
         lines[-1] += (f"dispatches {rec.get('dispatches', 0)}  "
                       f"source {rec.get('source') or '?'}")
+    # Per-program memory ledger (the runner's memory_analysis() record):
+    # args/out are the program's bound buffers, temp is the transient HBM
+    # the cost model's peak_hbm_bytes adds to resident state. Absent on
+    # backends that report no analysis — the table stays off.
+    mem = [(sig, rec) for sig, rec in
+           sorted((doc.get("programs") or {}).items())
+           if any(rec.get(k) is not None
+                  for k in ("argument_bytes", "output_bytes",
+                            "temp_bytes", "generated_code_bytes"))]
+    if mem:
+        lines.append("memory   sig       args        out       temp"
+                     "    codegen")
+        for sig, rec in mem:
+            lines.append(
+                f"  {sig:<8}"
+                f"{_fmt_bytes(rec.get('argument_bytes')):>9} "
+                f"{_fmt_bytes(rec.get('output_bytes')):>10} "
+                f"{_fmt_bytes(rec.get('temp_bytes')):>10} "
+                f"{_fmt_bytes(rec.get('generated_code_bytes')):>10}")
+        temps = [rec.get("temp_bytes") for _, rec in mem
+                 if rec.get("temp_bytes") is not None]
+        if temps:
+            lines.append(f"  peak temp {_fmt_bytes(max(temps))} "
+                         f"(the transient term of predicted peak HBM)")
     return lines
 
 

@@ -194,6 +194,38 @@ def _recovery_lines(status: dict) -> list:
     return out
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _memory_lines(status: dict) -> list:
+    """The status payload's ``memory`` section (the memory plane's census):
+    live bytes + pressure against the booked budget, then one owner=bytes
+    pair per non-zero census owner (``other`` is the unclaimed residual —
+    the leak-hunting number). Nothing while the plane never armed — the
+    healthy screen stays unchanged."""
+    mem = status.get("memory") or {}
+    owned = mem.get("owned") or {}
+    if not owned and not mem.get("live_bytes"):
+        return []
+    head = (f"mem      live {_fmt_bytes(mem.get('live_bytes'))}  "
+            f"pressure {mem.get('pressure', 0.0):.2f}")
+    if mem.get("budget_bytes"):
+        head += (f" (budget {_fmt_bytes(mem['budget_bytes'])}, "
+                 f"{mem.get('budget_source') or '?'})")
+    out = [head]
+    pairs = "  ".join(f"{owner} {_fmt_bytes(n)}"
+                      for owner, n in sorted(owned.items()) if n)
+    if pairs:
+        out.append(f"  owned  {pairs}")
+    return out
+
+
 def _staleness_compact(hist: dict) -> str:
     body = ",".join(f"{k[3:]}:{n}" for k, n in hist.items()
                     if k.startswith("le:") and n)
@@ -303,6 +335,7 @@ def render(status: dict, address: str = "") -> str:
     lines.extend(_perf_lines(reg))
     lines.extend(_req_lines(reg, status.get("alerts") or {}))
     lines.extend(_health_lines(reg))
+    lines.extend(_memory_lines(status))
     lines.extend(_alert_lines(status.get("alerts") or {}))
     lines.extend(_recovery_lines(status))
     events = status.get("events") or status.get("anomalies") or []
